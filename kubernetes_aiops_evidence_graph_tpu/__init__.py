@@ -1,0 +1,28 @@
+"""kubernetes_aiops_evidence_graph_tpu — TPU-native Kubernetes AIOps evidence-graph platform.
+
+A ground-up re-design of the capabilities of
+``ShreyashDarade/Kubernetes-AIOps-Evidence-Graph`` (see SURVEY.md) for TPU:
+
+* alerts are ingested, normalized and deduplicated (`ingestion/`);
+* evidence is collected from cluster backends (`collectors/`) — real HTTP/K8s
+  or a hermetic replayable fake driven by the simulator (`simulator/`);
+* evidence is assembled into an **in-memory tensorized evidence graph**
+  (`graph/`): CSR adjacency per relation type + dense node features;
+* root-cause analysis runs through a plugin seam (`rca/`):
+  ``cpu`` — a faithful rules-engine oracle, ``tpu`` — a batched, vectorized
+  scorer (segment-sum message passing + masked rule matching) that scores
+  *all* open incidents in one jitted pass (`ops/`, `parallel/`);
+* a durable async workflow engine (`workflow/`) reproduces the reference's
+  12-step incident lifecycle without Temporal;
+* safety path: policy engine, blast radius, executor, verifier (`policy/`,
+  `remediation/`), runbooks and integrations (`runbook/`, `integrations/`);
+* persistence (`storage/`) and observability (`observability/`).
+
+Import as ``import kubernetes_aiops_evidence_graph_tpu as kaeg``.
+"""
+
+__version__ = "0.1.0"
+
+# Keep the top-level import light: jax-heavy modules are imported lazily by
+# the subpackages that need them so that pure-CPU paths (models, ingestion,
+# policy) never pay JAX import/compile cost.

@@ -1,0 +1,132 @@
+"""Environment-driven configuration.
+
+Capability parity with the reference settings singleton
+(src/config/settings.py:11-153 in the reference): one flat, env-overridable
+settings object covering app/api/storage/k8s/observability/llm/policy/
+integrations/evidence/remediation knobs, plus TPU-specific knobs the
+reference has no analog for (mesh shape, padding buckets, rca backend).
+
+Implemented as a frozen dataclass built from ``os.environ`` — no
+pydantic-settings dependency, import-cheap, and hashable so jitted code can
+close over derived static values.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from functools import lru_cache
+from typing import Any
+
+
+def _env(name: str, default: Any, cast: type) -> Any:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclass(frozen=True)
+class Settings:
+    # --- app ---
+    app_name: str = "kaeg-tpu"
+    app_env: str = "development"  # development|staging|uat|production
+    log_level: str = "INFO"
+    debug: bool = False
+
+    # --- api / ingestion (reference settings.py api_* / security) ---
+    api_host: str = "0.0.0.0"
+    api_port: int = 8000
+    webhook_rate_limit_per_minute: int = 100       # settings.py:119
+    dedup_ttl_seconds: int = 4 * 3600              # deduplicator.py:20 (4h)
+
+    # --- storage ---
+    db_path: str = "kaeg.sqlite"                   # replaces Postgres DSN
+    graph_persist_path: str = ""                   # optional snapshot dump dir
+
+    # --- evidence collection (settings.py:134-136) ---
+    evidence_time_window_minutes: int = 15
+    max_log_lines: int = 1000
+    max_metric_points: int = 500
+
+    # --- collector backends ---
+    cluster_backend: str = "fake"                  # fake|kubernetes
+    prometheus_url: str = "http://localhost:9090"
+    loki_url: str = "http://localhost:3100"
+    kubeconfig: str = ""
+
+    # --- rca ---
+    rca_backend: str = "tpu"                       # cpu|tpu (plugin seam, BASELINE.json north star)
+    rca_propagation_hops: int = 3                  # graph depth analog (neo4j.py:174 maxLevel=3)
+    llm_provider: str = "none"                     # none|gemini|openai|ollama
+    llm_api_key: str = ""
+    llm_model: str = ""
+
+    # --- remediation / policy (settings.py remediation_*) ---
+    remediation_enabled: bool = True
+    remediation_dry_run: bool = True
+    remediation_auto_approve_dev: bool = True
+    remediation_max_blast_radius: float = 50.0
+    verification_wait_seconds: int = 120           # incident_workflow.py:229
+    approval_timeout_seconds: int = 4 * 3600       # incident_workflow.py:198
+
+    # --- integrations ---
+    slack_webhook_url: str = ""
+    slack_channel: str = "#incidents"
+    jira_url: str = ""
+    jira_project: str = "OPS"
+
+    # --- observability ---
+    metrics_enabled: bool = True
+    tracing_enabled: bool = True
+
+    # --- TPU-native knobs (new in this framework) ---
+    mesh_dp: int = 1                               # data-parallel axis (incidents)
+    mesh_graph: int = 1                            # graph-parallel axis (node shards)
+    node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)
+    edge_bucket_sizes: tuple = (1024, 4096, 16384, 65536, 262144)
+    incident_bucket_sizes: tuple = (8, 32, 128, 512)
+    use_pallas: bool = False                       # opt-in pallas SpMM kernel
+
+    @property
+    def environment(self) -> str:
+        """Normalized short environment name (dev|staging|uat|prod)."""
+        e = self.app_env.lower()
+        return {"development": "dev", "production": "prod"}.get(e, e)
+
+
+_ENV_PREFIX = "KAEG_"
+
+
+def load_settings(**overrides: Any) -> Settings:
+    """Build Settings from KAEG_* env vars, then apply explicit overrides."""
+    kwargs: dict[str, Any] = {}
+    for f in fields(Settings):
+        env_name = _ENV_PREFIX + f.name.upper()
+        if env_name in os.environ:
+            if isinstance(f.default, bool):
+                cast = bool
+            elif isinstance(f.default, int):
+                cast = int
+            elif isinstance(f.default, float):
+                cast = float
+            elif isinstance(f.default, tuple):
+                kwargs[f.name] = tuple(
+                    int(p) for p in os.environ[env_name].split(",") if p.strip()
+                )
+                continue
+            else:
+                cast = str
+            kwargs[f.name] = _env(env_name, f.default, cast)
+    kwargs.update(overrides)
+    return Settings(**kwargs)
+
+
+@lru_cache(maxsize=1)
+def get_settings() -> Settings:
+    """Process-wide singleton (reference settings.py:146-153)."""
+    return load_settings()
+
+
+settings = get_settings()

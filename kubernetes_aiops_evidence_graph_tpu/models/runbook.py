@@ -1,0 +1,33 @@
+"""Runbook model (reference persists runbooks as a Postgres row,
+src/services/runbook/generator.py:273-293 + scripts/init-db.sql runbooks
+table)."""
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any
+from uuid import UUID, uuid4
+
+from pydantic import BaseModel, Field
+
+from .incident import utcnow
+
+
+class RunbookStep(BaseModel):
+    order: int
+    title: str
+    description: str = ""
+    commands: list[str] = Field(default_factory=list)
+
+
+class Runbook(BaseModel):
+    id: UUID = Field(default_factory=uuid4)
+    incident_id: UUID
+    hypothesis_id: UUID | None = None
+    title: str
+    summary: str = ""
+    steps: list[RunbookStep] = Field(default_factory=list)
+    kubectl_commands: list[str] = Field(default_factory=list)
+    investigation_queries: list[str] = Field(default_factory=list)
+    dashboard_links: dict[str, str] = Field(default_factory=dict)
+    metadata: dict[str, Any] = Field(default_factory=dict)
+    generated_at: datetime = Field(default_factory=utcnow)

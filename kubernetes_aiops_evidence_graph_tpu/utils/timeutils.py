@@ -1,0 +1,25 @@
+"""Time helpers (timezone-aware UTC everywhere)."""
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+
+
+def utcnow() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def minutes_ago(minutes: float, now: datetime | None = None) -> datetime:
+    return (now or utcnow()) - timedelta(minutes=minutes)
+
+
+def to_epoch_s(dt: datetime) -> float:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
+
+
+def parse_iso(s: str) -> datetime:
+    dt = datetime.fromisoformat(s.replace("Z", "+00:00"))
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt

@@ -1,0 +1,46 @@
+from uuid import uuid4
+
+import pytest
+
+import kubernetes_aiops_evidence_graph_tpu.models as m
+
+
+def test_incident_roundtrip():
+    inc = m.Incident(fingerprint="abc", title="Pod CrashLoopBackOff: api", severity=m.Severity.CRITICAL)
+    assert inc.status == m.IncidentStatus.OPEN
+    blob = inc.model_dump_json()
+    back = m.Incident.model_validate_json(blob)
+    assert back.fingerprint == "abc"
+    assert back.severity == m.Severity.CRITICAL
+
+
+def test_evidence_signal_strength_bounds():
+    with pytest.raises(Exception):
+        m.Evidence(
+            incident_id=uuid4(), evidence_type=m.EvidenceType.KUBERNETES_POD,
+            source=m.EvidenceSource.KUBERNETES_API, entity_name="p", signal_strength=1.5,
+        )
+
+
+def test_enum_vocabulary_parity():
+    # Parity facts vs reference (src/models/*.py): counts of enum vocabularies.
+    assert len(m.EvidenceType) == 16
+    assert len(m.HypothesisCategory) == 11
+    assert len(m.ActionType) == 14
+    assert len(m.ActionStatus) == 9
+    assert {s.value for s in m.Severity} == {"critical", "high", "medium", "low", "info"}
+    assert {e.value for e in m.Environment} == {"dev", "staging", "uat", "prod"}
+
+
+def test_collector_result_defaults():
+    r = m.CollectorResult(collector_name="kubernetes")
+    assert r.success and r.evidence == [] and r.errors == []
+
+
+def test_action_lifecycle_fields():
+    a = m.RemediationAction(
+        incident_id=uuid4(), idempotency_key="k", action_type=m.ActionType.RESTART_POD,
+        target_resource="api",
+    )
+    assert a.status == m.ActionStatus.PROPOSED
+    assert a.requires_approval is True

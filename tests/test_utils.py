@@ -1,0 +1,46 @@
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.utils import (
+    alert_fingerprint, bucket_for, pad_to, stable_hash,
+)
+
+
+def test_fingerprint_deterministic_and_shaped():
+    fp1 = alert_fingerprint("alertmanager", "PodCrashLooping", "default", "api")
+    fp2 = alert_fingerprint("alertmanager", "PodCrashLooping", "default", "api")
+    assert fp1 == fp2 and len(fp1) == 32
+    assert fp1 != alert_fingerprint("alertmanager", "PodCrashLooping", "default", "other")
+    # None service folds to empty string
+    assert alert_fingerprint("a", "b", "c", None) == alert_fingerprint("a", "b", "c", "")
+
+
+def test_stable_hash_is_stable():
+    assert stable_hash("pod", "default", "api-1") == stable_hash("pod", "default", "api-1")
+    assert stable_hash("pod", "default", "api-1") != stable_hash("pod", "default", "api-2")
+
+
+def test_bucket_ladder():
+    buckets = (256, 1024, 4096)
+    assert bucket_for(1, buckets) == 256
+    assert bucket_for(256, buckets) == 256
+    assert bucket_for(257, buckets) == 1024
+    assert bucket_for(5000, buckets) == 8192  # next pow2 past ladder
+
+
+def test_pad_to():
+    a = np.ones((3, 2))
+    p = pad_to(a, 5, axis=0, fill=-1)
+    assert p.shape == (5, 2) and p[3:].min() == -1
+    with pytest.raises(ValueError):
+        pad_to(a, 2, axis=0)
+
+
+def test_settings_env_override(monkeypatch):
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    monkeypatch.setenv("KAEG_RCA_BACKEND", "cpu")
+    monkeypatch.setenv("KAEG_MESH_DP", "4")
+    s = load_settings()
+    assert s.rca_backend == "cpu" and s.mesh_dp == 4
+    assert load_settings(rca_backend="tpu").rca_backend == "tpu"
+    assert load_settings(app_env="production").environment == "prod"
