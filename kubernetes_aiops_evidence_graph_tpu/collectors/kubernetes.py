@@ -1,0 +1,159 @@
+"""Kubernetes state collector.
+
+Parity with the reference KubernetesCollector (kubernetes_collector.py:50-625):
+five sub-collections (pods, deployments, events, nodes, HPAs), the same
+signal-strength heuristic (:269-285 — crash/image/OOM reasons 0.95,
+restarts>3 0.8, non-Running 0.7, else 0.3), unhealthy-only node emission
+(:504-557), and the Pod/Deployment/Node/Service entity + SCHEDULED_ON/OWNS/
+SELECTS/AFFECTS relation emission (:296-313). Queries go through the
+ClusterBackend interface instead of the kubernetes client, so the same code
+runs against FakeCluster or a real API server.
+"""
+from __future__ import annotations
+
+from ..graph import ids
+from ..models import (
+    CollectorResult,
+    EvidenceSource,
+    EvidenceType,
+    GraphEntity,
+    GraphRelation,
+    Incident,
+)
+from .base import BaseCollector
+
+_CRITICAL_WAITING = {"CrashLoopBackOff", "ImagePullBackOff", "ErrImagePull", "ImageInspectError"}
+_CRITICAL_EVENTS = {"FailedScheduling", "FailedMount", "BackOff", "Unhealthy", "Failed",
+                    "OOMKilling", "NodeNotReady"}
+
+
+def pod_signal_strength(waiting: str | None, terminated: str | None,
+                        restarts: int, phase: str) -> float:
+    """Reference heuristic (kubernetes_collector.py:269-285)."""
+    if (waiting in _CRITICAL_WAITING) or terminated == "OOMKilled":
+        return 0.95
+    if restarts > 3:
+        return 0.8
+    if phase != "Running":
+        return 0.7
+    return 0.3
+
+
+class KubernetesCollector(BaseCollector):
+    name = "kubernetes"
+    source = EvidenceSource.KUBERNETES_API
+
+    def collect(self, incident: Incident) -> CollectorResult:
+        result = CollectorResult(collector_name=self.name)
+        ns, svc = incident.namespace, incident.service
+        inc_node = ids.incident_id(str(incident.id))
+
+        self._collect_pods(incident, ns, svc, inc_node, result)
+        self._collect_deployments(incident, ns, svc, inc_node, result)
+        self._collect_events(incident, ns, result)
+        self._collect_nodes(incident, result)
+        self._collect_hpas(incident, ns, svc, result)
+        return result
+
+    def _collect_pods(self, incident, ns, svc, inc_node, result) -> None:
+        for p in self.backend.list_pods(ns, svc):
+            strength = pod_signal_strength(p.waiting_reason, p.terminated_reason,
+                                           p.restart_count, p.phase)
+            data = {
+                "waiting_reason": p.waiting_reason,
+                "terminated_reason": p.terminated_reason,
+                "restart_count": p.restart_count,
+                "ready": p.ready,
+                "not_ready_seconds": p.not_ready_seconds,
+                "readiness_probe_failing": p.readiness_probe_failing,
+                "phase": p.phase,
+                "node": p.node,
+            }
+            result.evidence.append(self.make_evidence(
+                incident, EvidenceType.KUBERNETES_POD, p.name, data,
+                signal_strength=strength, is_anomaly=strength >= 0.7, namespace=ns,
+            ))
+            pod_node = ids.pod_id(ns, p.name)
+            result.entities.append(GraphEntity(id=pod_node, type="Pod", properties=data))
+            result.entities.append(GraphEntity(id=ids.node_id(p.node), type="Node"))
+            result.relations.append(GraphRelation(
+                source_id=pod_node, target_id=ids.node_id(p.node), relation_type="SCHEDULED_ON"))
+            result.relations.append(GraphRelation(
+                source_id=ids.deployment_id(ns, p.deployment), target_id=pod_node,
+                relation_type="OWNS"))
+            result.relations.append(GraphRelation(
+                source_id=ids.service_id(ns, p.service), target_id=pod_node,
+                relation_type="SELECTS"))
+            result.relations.append(GraphRelation(
+                source_id=inc_node, target_id=pod_node, relation_type="AFFECTS"))
+
+    def _collect_deployments(self, incident, ns, svc, inc_node, result) -> None:
+        for d in self.backend.list_deployments(ns, svc):
+            unavailable = max(0, d.replicas - d.ready_replicas)
+            data = {
+                "replicas": d.replicas,
+                "ready_replicas": d.ready_replicas,
+                "unavailable_replicas": unavailable,
+                "revision": d.revision,
+                "image": d.image,
+            }
+            result.evidence.append(self.make_evidence(
+                incident, EvidenceType.KUBERNETES_DEPLOYMENT, d.name, data,
+                signal_strength=0.8 if unavailable else 0.3,  # :406-417
+                is_anomaly=unavailable > 0, namespace=ns,
+            ))
+            dep_node = ids.deployment_id(ns, d.name)
+            result.entities.append(GraphEntity(id=dep_node, type="Deployment", properties=data))
+            result.entities.append(GraphEntity(
+                id=ids.service_id(ns, d.service), type="Service",
+                properties={"name": d.service, "namespace": ns}))
+            result.relations.append(GraphRelation(
+                source_id=inc_node, target_id=dep_node, relation_type="AFFECTS"))
+
+    def _collect_events(self, incident, ns, result) -> None:
+        start, _ = self.window(incident, self.backend.now)
+        for e in self.backend.list_events(ns, start):
+            if e.type != "Warning":
+                continue
+            strength = 0.9 if e.reason in _CRITICAL_EVENTS else 0.5  # :476-482
+            result.evidence.append(self.make_evidence(
+                incident, EvidenceType.KUBERNETES_EVENT, e.involved_object,
+                {"reason": e.reason, "message": e.message, "type": e.type},
+                signal_strength=strength, is_anomaly=strength >= 0.9, namespace=ns,
+            ))
+
+    def _collect_nodes(self, incident, result) -> None:
+        for n in self.backend.list_nodes():
+            ready = n.conditions.get("Ready", "True")
+            pressures = {
+                k: v for k, v in n.conditions.items()
+                if k in ("MemoryPressure", "DiskPressure", "PIDPressure", "NetworkUnavailable")
+                and v == "True"
+            }
+            if ready == "True" and not pressures:
+                continue  # only unhealthy nodes are evidence (:504-557)
+            data = {"name": n.name, "conditions": {k: {"status": v} for k, v in n.conditions.items()}}
+            result.evidence.append(self.make_evidence(
+                incident, EvidenceType.KUBERNETES_NODE, n.name, data,
+                signal_strength=0.85, is_anomaly=True, namespace=incident.namespace,
+            ))
+            result.entities.append(GraphEntity(id=ids.node_id(n.name), type="Node", properties=data))
+
+    def _collect_hpas(self, incident, ns, svc, result) -> None:
+        for h in self.backend.list_hpas(ns, svc):
+            at_max = h.at_max or h.current_replicas >= h.max_replicas
+            data = {
+                "at_max": at_max,
+                "current_replicas": h.current_replicas,
+                "max_replicas": h.max_replicas,
+            }
+            result.evidence.append(self.make_evidence(
+                incident, EvidenceType.KUBERNETES_HPA, h.name, data,
+                signal_strength=0.8 if at_max else 0.3, is_anomaly=at_max, namespace=ns,  # :577-625
+            ))
+            result.entities.append(GraphEntity(
+                id=ids.hpa_id(ns, h.name), type="HPA", properties=data))
+            result.relations.append(GraphRelation(
+                source_id=ids.hpa_id(ns, h.name),
+                target_id=ids.deployment_id(ns, h.deployment),
+                relation_type="OWNS"))

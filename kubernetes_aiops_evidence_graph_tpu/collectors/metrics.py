@@ -1,0 +1,113 @@
+"""Metrics collector — alert-driven PromQL category selection.
+
+Parity with the reference MetricsCollector (metrics_collector.py:31-329):
+loads the promql library, selects categories by alertname keywords
+(:78-99), queries the backend per named query, and applies the per-family
+anomaly thresholds (:247-329) to set signal strength. Emits one
+METRIC_SIGNAL evidence per query with ``query_name`` / ``current_value`` /
+``is_anomalous`` — the exact keys the signal fold reads
+(rules_engine.py:337-350).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+from ..models import CollectorResult, EvidenceSource, EvidenceType, Incident
+from .base import BaseCollector
+
+_QUERIES_PATH = Path(__file__).resolve().parent.parent / "config" / "promql_queries.yaml"
+
+# alertname keyword -> categories (reference :78-99)
+_KEYWORD_CATEGORIES = (
+    ("crash", ("crashloop", "resource")),
+    ("oom", ("oom", "resource")),
+    ("memory", ("oom", "resource")),
+    ("imagepull", ("deployment",)),
+    ("notready", ("node", "deployment")),
+    ("node", ("node",)),
+    ("hpa", ("hpa", "latency")),
+    ("scal", ("hpa", "latency")),
+    ("latency", ("latency", "error_rate")),
+    ("slow", ("latency", "error_rate")),
+    ("error", ("error_rate", "network")),
+    ("throttl", ("resource",)),
+)
+_DEFAULT_CATEGORIES = ("crashloop", "resource", "error_rate")
+
+# query family -> (threshold, predicate description) (reference :247-329)
+_THRESHOLDS: dict[str, float] = {
+    "pod_restarts": 5.0,
+    "error_rate": 0.1,
+    "memory_usage_pct": 90.0,
+    "latency_p99_seconds": 5.0,
+    "cpu_throttle_ratio": 0.5,
+    "oom_events": 0.0,      # any OOM is anomalous (strict >)
+    "hpa_at_max": 0.5,      # gauge 0/1
+}
+_STRENGTH: dict[str, float] = {
+    "pod_restarts": 0.9,
+    "error_rate": 0.9,
+    "memory_usage_pct": 0.9,
+    "latency_p99_seconds": 0.9,
+    "cpu_throttle_ratio": 0.8,
+    "oom_events": 0.95,
+    "hpa_at_max": 0.8,
+}
+
+
+def load_query_library() -> dict[str, dict[str, str]]:
+    with open(_QUERIES_PATH) as fh:
+        return yaml.safe_load(fh)
+
+
+def select_categories(alertname: str) -> list[str]:
+    lowered = (alertname or "").lower()
+    cats: list[str] = []
+    for keyword, categories in _KEYWORD_CATEGORIES:
+        if keyword in lowered:
+            for c in categories:
+                if c not in cats:
+                    cats.append(c)
+    return cats or list(_DEFAULT_CATEGORIES)
+
+
+class MetricsCollector(BaseCollector):
+    name = "metrics"
+    source = EvidenceSource.PROMETHEUS
+
+    def __init__(self, backend, settings=None) -> None:
+        super().__init__(backend, settings)
+        self.library = load_query_library()
+
+    def collect(self, incident: Incident) -> CollectorResult:
+        result = CollectorResult(collector_name=self.name)
+        if not incident.service:
+            return result
+        alertname = incident.labels.get("alertname", incident.title)
+        seen: set[str] = set()
+        for category in select_categories(alertname):
+            for query_name in self.library.get(category, {}):
+                if query_name in seen:
+                    continue
+                seen.add(query_name)
+                value = self.backend.query_metric(
+                    incident.namespace, incident.service, query_name)
+                if value is None:
+                    continue
+                threshold = _THRESHOLDS.get(query_name)
+                anomalous = threshold is not None and value > threshold
+                result.evidence.append(self.make_evidence(
+                    incident, EvidenceType.METRIC_SIGNAL, incident.service,
+                    {
+                        "query_name": query_name,
+                        "category": category,
+                        "current_value": float(value),
+                        "threshold": threshold,
+                        "is_anomalous": anomalous,
+                    },
+                    signal_strength=_STRENGTH.get(query_name, 0.5) if anomalous else 0.3,
+                    is_anomaly=anomalous,
+                ))
+        return result

@@ -1,3 +1,3 @@
-from .settings import Settings, get_settings, load_settings, settings
+from .settings import Settings, get_settings, load_settings
 
-__all__ = ["Settings", "get_settings", "load_settings", "settings"]
+__all__ = ["Settings", "get_settings", "load_settings"]

@@ -18,13 +18,12 @@ from functools import lru_cache
 from typing import Any
 
 
-def _env(name: str, default: Any, cast: type) -> Any:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    if cast is bool:
-        return raw.strip().lower() in ("1", "true", "yes", "on")
-    return cast(raw)
+def _parse_buckets(raw: str) -> tuple[int, ...]:
+    """Parse a bucket ladder from env: positive ints, sorted ascending."""
+    vals = sorted(int(p) for p in raw.split(",") if p.strip())
+    if not vals or vals[0] <= 0:
+        raise ValueError(f"bucket ladder must be positive ints, got {raw!r}")
+    return tuple(vals)
 
 
 @dataclass(frozen=True)
@@ -104,29 +103,24 @@ def load_settings(**overrides: Any) -> Settings:
     kwargs: dict[str, Any] = {}
     for f in fields(Settings):
         env_name = _ENV_PREFIX + f.name.upper()
-        if env_name in os.environ:
-            if isinstance(f.default, bool):
-                cast = bool
-            elif isinstance(f.default, int):
-                cast = int
-            elif isinstance(f.default, float):
-                cast = float
-            elif isinstance(f.default, tuple):
-                kwargs[f.name] = tuple(
-                    int(p) for p in os.environ[env_name].split(",") if p.strip()
-                )
-                continue
-            else:
-                cast = str
-            kwargs[f.name] = _env(env_name, f.default, cast)
+        if env_name not in os.environ:
+            continue
+        raw = os.environ[env_name]
+        if isinstance(f.default, bool):
+            kwargs[f.name] = raw.strip().lower() in ("1", "true", "yes", "on")
+        elif isinstance(f.default, int):
+            kwargs[f.name] = int(raw)
+        elif isinstance(f.default, float):
+            kwargs[f.name] = float(raw)
+        elif isinstance(f.default, tuple):
+            kwargs[f.name] = _parse_buckets(raw)
+        else:
+            kwargs[f.name] = raw
     kwargs.update(overrides)
     return Settings(**kwargs)
 
 
 @lru_cache(maxsize=1)
 def get_settings() -> Settings:
-    """Process-wide singleton (reference settings.py:146-153)."""
+    """Process-wide lazy singleton (reference settings.py:146-153)."""
     return load_settings()
-
-
-settings = get_settings()

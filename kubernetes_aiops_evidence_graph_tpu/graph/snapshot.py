@@ -1,0 +1,236 @@
+"""GraphSnapshot — the tensorized, XLA-ready view of the evidence graph.
+
+This is the data structure the whole TPU path consumes: dense node-feature
+matrix + COO edge lists, padded to bucket ladders (utils/padding.py) so jit
+caches stay warm under pod churn. It replaces the reference's per-incident
+Cypher traversals (neo4j.py:169-201) with one whole-graph array view that
+scores *all* incidents in a single batched pass (BASELINE.json north star).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ..config import Settings, get_settings
+from ..utils.padding import bucket_for, pad_to
+from ..utils.timeutils import parse_iso, to_epoch_s, utcnow
+from .schema import (
+    DIM,
+    EntityKind,
+    F,
+    LOG_PATTERN_FEATURES,
+    NODE_CONDITION_FEATURES,
+    RelationKind,
+    TERMINATED_REASON_FEATURES,
+    WAITING_REASON_FEATURES,
+)
+from .store import EvidenceGraphStore, _Node
+
+
+def extract_node_features(node: _Node, now_s: float | None = None) -> np.ndarray:
+    """Fold a node's property bag into the fixed feature vector.
+
+    Tensor analog of the reference's per-evidence signal fold
+    (rules_engine.py:292-357): the same keys are read, but from graph-node
+    properties (set by collectors/builder) instead of evidence dicts.
+    """
+    f = np.zeros(DIM, dtype=np.float32)
+    p = node.properties
+
+    wr = p.get("waiting_reason")
+    if wr in WAITING_REASON_FEATURES:
+        f[WAITING_REASON_FEATURES[wr]] = 1.0
+    tr = p.get("terminated_reason")
+    if tr in TERMINATED_REASON_FEATURES:
+        f[TERMINATED_REASON_FEATURES[tr]] = 1.0
+
+    f[F.RESTART_COUNT] = float(p.get("restart_count", 0) or 0)
+    if p.get("ready") is False:
+        not_ready_s = float(p.get("not_ready_seconds", 0) or 0)
+        if not_ready_s >= 300:  # rule readiness_probe_failing duration_seconds: 300
+            f[F.POD_NOT_READY] = 1.0
+    if p.get("readiness_probe_failing"):
+        f[F.READINESS_PROBE_FAILING] = 1.0
+
+    f[F.ERROR_COUNT] = float(p.get("error_count", 0) or 0)
+    for pat in p.get("patterns_found", ()) or ():
+        idx = LOG_PATTERN_FEATURES.get(pat)
+        if idx is not None:
+            f[idx] = 1.0
+
+    if p.get("is_recent_change"):
+        f[F.HAS_RECENT_DEPLOY] = 1.0
+    if p.get("image_changed"):
+        f[F.HAS_IMAGE_CHANGE] = 1.0
+    if p.get("config_changed"):
+        f[F.HAS_CONFIG_CHANGE] = 1.0
+    ts = p.get("changed_at")
+    if ts is not None:
+        when = parse_iso(ts) if isinstance(ts, str) else ts
+        age_min = max(0.0, ((now_s or to_epoch_s(utcnow())) - to_epoch_s(when)) / 60.0)
+        f[F.CHANGE_RECENCY] = max(0.0, 1.0 - age_min / 30.0)  # 30min window, deploy_diff_collector.py:93-215
+
+    if p.get("memory_usage_high"):
+        f[F.MEMORY_USAGE_HIGH] = 1.0
+    if p.get("cpu_throttling"):
+        f[F.CPU_THROTTLING] = 1.0
+    if p.get("hpa_at_max") or p.get("at_max"):
+        f[F.HPA_AT_MAX] = 1.0
+    if p.get("latency_high"):
+        f[F.LATENCY_HIGH] = 1.0
+
+    conds = p.get("conditions") or {}
+    if node.kind == EntityKind.NODE:
+        ready = conds.get("Ready")
+        status = ready.get("status") if isinstance(ready, dict) else ready
+        if status is not None and status != "True":
+            f[F.NODE_NOT_READY] = 1.0
+        for cname, idx in NODE_CONDITION_FEATURES.items():
+            if cname == "NotReady":
+                continue
+            c = conds.get(cname)
+            cstatus = c.get("status") if isinstance(c, dict) else c
+            if cstatus == "True":
+                f[idx] = 1.0
+
+    if node.kind == EntityKind.POD and (
+        p.get("waiting_reason")
+        or p.get("terminated_reason")
+        or float(p.get("restart_count", 0) or 0) > 3  # PROBLEM_POD_RESTARTS
+        or p.get("ready") is False
+    ):
+        f[F.POD_PROBLEM] = 1.0
+
+    f[F.NETWORK_ERROR_COUNT] = float(p.get("network_error_count", 0) or 0)
+    f[F.SIGNAL_STRENGTH] = float(p.get("signal_strength", 0.0) or 0.0)
+    if p.get("is_anomaly"):
+        f[F.IS_ANOMALY] = 1.0
+    if float(p.get("unavailable_replicas", 0) or 0) > 0:
+        f[F.DEPLOY_UNAVAILABLE] = 1.0
+
+    return f
+
+
+@dataclass(frozen=True)
+class GraphSnapshot:
+    """Immutable padded tensor view of the evidence graph.
+
+    Shapes (P* = padded to bucket):
+      node_kind  int32  [Pn]      features  float32 [Pn, DIM]
+      node_mask  f32    [Pn]      (1.0 real / 0.0 pad)
+      edge_src   int32  [Pe]      edge_dst  int32 [Pe]   edge_rel int32 [Pe]
+      edge_mask  f32    [Pe]      (padded edges self-loop on pad node 0 weight)
+      incident_nodes int32 [Pi]   incident_mask f32 [Pi]
+    """
+    node_ids: tuple[str, ...]
+    incident_ids: tuple[str, ...]
+    num_nodes: int
+    num_edges: int
+    num_incidents: int
+    node_kind: np.ndarray
+    features: np.ndarray
+    node_mask: np.ndarray
+    edge_src: np.ndarray
+    edge_dst: np.ndarray
+    edge_rel: np.ndarray
+    edge_mask: np.ndarray
+    incident_nodes: np.ndarray
+    incident_mask: np.ndarray
+    version: int = 0
+
+    @property
+    def padded_nodes(self) -> int:
+        return int(self.node_kind.shape[0])
+
+    @property
+    def padded_edges(self) -> int:
+        return int(self.edge_src.shape[0])
+
+    @property
+    def padded_incidents(self) -> int:
+        return int(self.incident_nodes.shape[0])
+
+    def typed_edges(self, kind: RelationKind) -> tuple[np.ndarray, np.ndarray]:
+        """(src, dst) for one relation kind, unpadded."""
+        sel = (self.edge_rel == int(kind)) & (self.edge_mask > 0)
+        return self.edge_src[sel], self.edge_dst[sel]
+
+    def index_of(self, node_id: str) -> int:
+        return self.node_ids.index(node_id)
+
+
+def build_snapshot(
+    store: EvidenceGraphStore,
+    settings: Settings | None = None,
+    now_s: float | None = None,
+    undirected: bool = True,
+) -> GraphSnapshot:
+    """Tensorize the store. With ``undirected=True`` every edge is emitted in
+    both directions — matching apoc.path.subgraphAll's undirected expansion
+    (neo4j.py:174) so propagation reaches owners and dependents alike."""
+    cfg = settings or get_settings()
+    nodes, edges = store._raw()
+
+    n = len(nodes)
+    pn = bucket_for(max(n, 1), cfg.node_bucket_sizes)
+
+    node_kind = np.zeros(pn, dtype=np.int32)
+    features = np.zeros((pn, DIM), dtype=np.float32)
+    node_mask = np.zeros(pn, dtype=np.float32)
+    incident_rows: list[int] = []
+    incident_ids: list[str] = []
+
+    for i, node in enumerate(nodes):
+        node_kind[i] = int(node.kind)
+        features[i] = extract_node_features(node, now_s=now_s)
+        node_mask[i] = 1.0
+        if node.kind == EntityKind.INCIDENT:
+            incident_rows.append(i)
+            incident_ids.append(node.id)
+
+    raw_edges: list[tuple[int, int, int]] = []
+    id_to_idx = {node.id: i for i, node in enumerate(nodes)}
+    for e in edges:
+        s, d = id_to_idx[e.src], id_to_idx[e.dst]
+        raw_edges.append((s, d, int(e.kind)))
+        if undirected:
+            raw_edges.append((d, s, int(e.kind)))
+
+    m = len(raw_edges)
+    pe = bucket_for(max(m, 1), cfg.edge_bucket_sizes)
+    edge_src = np.zeros(pe, dtype=np.int32)
+    edge_dst = np.zeros(pe, dtype=np.int32)
+    edge_rel = np.full(pe, -1, dtype=np.int32)
+    edge_mask = np.zeros(pe, dtype=np.float32)
+    if m:
+        arr = np.asarray(raw_edges, dtype=np.int32)
+        edge_src[:m], edge_dst[:m], edge_rel[:m] = arr[:, 0], arr[:, 1], arr[:, 2]
+        edge_mask[:m] = 1.0
+
+    ni = len(incident_rows)
+    pi = bucket_for(max(ni, 1), cfg.incident_bucket_sizes)
+    incident_nodes = np.zeros(pi, dtype=np.int32)
+    incident_mask = np.zeros(pi, dtype=np.float32)
+    if ni:
+        incident_nodes[:ni] = np.asarray(incident_rows, dtype=np.int32)
+        incident_mask[:ni] = 1.0
+
+    return GraphSnapshot(
+        node_ids=tuple(node.id for node in nodes),
+        incident_ids=tuple(incident_ids),
+        num_nodes=n,
+        num_edges=m,
+        num_incidents=ni,
+        node_kind=node_kind,
+        features=features,
+        node_mask=node_mask,
+        edge_src=edge_src,
+        edge_dst=edge_dst,
+        edge_rel=edge_rel,
+        edge_mask=edge_mask,
+        incident_nodes=incident_nodes,
+        incident_mask=incident_mask,
+        version=store.version,
+    )

@@ -1,0 +1,274 @@
+"""In-memory evidence-graph store — the Neo4j/GraphService replacement.
+
+Capability parity with the reference GraphService (src/database/neo4j.py:67-320):
+MERGE-semantics upserts, depth-limited incident subgraphs
+(apoc.path.subgraphAll, neo4j.py:169-201), time-windowed related changes
+(:204-228), affected-by-node traversal (:231-251), service dependency
+up/downstream (:254-278), and per-incident cleanup (:281-296).
+
+Unlike the reference — which issues one Bolt round-trip per node/edge
+(neo4j.py:95-166) — upserts here are O(1) dict operations and batch calls
+are true batches, and the whole graph tensorizes into a
+:class:`~.snapshot.GraphSnapshot` for TPU scoring.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Iterable, Optional
+
+from ..models import GraphEntity, GraphRelation
+from ..utils.timeutils import parse_iso
+from .schema import EntityKind, RelationKind
+
+
+@dataclass
+class _Node:
+    id: str
+    kind: EntityKind
+    label: str
+    index: int                      # dense, stable insertion index
+    properties: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class _Edge:
+    src: str
+    dst: str
+    kind: RelationKind
+    properties: dict[str, Any] = field(default_factory=dict)
+
+
+class EvidenceGraphStore:
+    """Mutable, thread-safe, in-memory property graph."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: dict[str, _Node] = {}
+        self._edges: dict[tuple[str, str, RelationKind], _Edge] = {}
+        self._out: dict[str, set[tuple[str, RelationKind]]] = {}
+        self._in: dict[str, set[tuple[str, RelationKind]]] = {}
+        self._version = 0  # bumps on every mutation; snapshot cache key
+
+    # -- mutation ---------------------------------------------------------
+
+    def upsert_entity(self, entity: GraphEntity) -> None:
+        self.upsert_entities([entity])
+
+    def upsert_entities(self, entities: Iterable[GraphEntity]) -> int:
+        """Batch MERGE of nodes (reference neo4j.py:95-112, but one lock +
+        dict ops instead of one session.run per entity)."""
+        n = 0
+        with self._lock:
+            for e in entities:
+                node = self._nodes.get(e.id)
+                if node is None:
+                    self._nodes[e.id] = _Node(
+                        id=e.id,
+                        kind=EntityKind.from_label(e.type),
+                        label=e.type,
+                        index=len(self._nodes),
+                        properties=dict(e.properties),
+                    )
+                    self._out.setdefault(e.id, set())
+                    self._in.setdefault(e.id, set())
+                else:
+                    node.properties.update(e.properties)
+                n += 1
+            self._version += 1
+        return n
+
+    def upsert_relations(self, relations: Iterable[GraphRelation]) -> int:
+        """Batch MERGE of edges (reference neo4j.py:145-166). Edges whose
+        endpoints don't exist yet get placeholder nodes (MERGE semantics)."""
+        n = 0
+        with self._lock:
+            for r in relations:
+                kind = RelationKind.from_label(r.relation_type)
+                for nid in (r.source_id, r.target_id):
+                    if nid not in self._nodes:
+                        label = nid.split(":", 1)[0].capitalize() if ":" in nid else "Container"
+                        self._nodes[nid] = _Node(
+                            id=nid, kind=EntityKind.from_label(label), label=label,
+                            index=len(self._nodes),
+                        )
+                        self._out.setdefault(nid, set())
+                        self._in.setdefault(nid, set())
+                key = (r.source_id, r.target_id, kind)
+                edge = self._edges.get(key)
+                if edge is None:
+                    self._edges[key] = _Edge(r.source_id, r.target_id, kind, dict(r.properties))
+                    self._out[r.source_id].add((r.target_id, kind))
+                    self._in[r.target_id].add((r.source_id, kind))
+                else:
+                    edge.properties.update(r.properties)
+                n += 1
+            self._version += 1
+        return n
+
+    def remove_node(self, node_id: str) -> bool:
+        with self._lock:
+            if node_id not in self._nodes:
+                return False
+            for dst, kind in list(self._out.get(node_id, ())):
+                self._edges.pop((node_id, dst, kind), None)
+                self._in[dst].discard((node_id, kind))
+            for src, kind in list(self._in.get(node_id, ())):
+                self._edges.pop((src, node_id, kind), None)
+                self._out[src].discard((node_id, kind))
+            self._out.pop(node_id, None)
+            self._in.pop(node_id, None)
+            del self._nodes[node_id]
+            # reassign dense indices
+            for i, node in enumerate(self._nodes.values()):
+                node.index = i
+            self._version += 1
+            return True
+
+    def cleanup_incident(self, incident_id: str) -> int:
+        """Remove an incident node and its relations (reference neo4j.py:281-296)."""
+        nid = incident_id if incident_id.startswith("incident:") else f"incident:{incident_id}"
+        return 1 if self.remove_node(nid) else 0
+
+    # -- queries ----------------------------------------------------------
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def node_count(self) -> int:
+        return len(self._nodes)
+
+    def edge_count(self) -> int:
+        return len(self._edges)
+
+    def get_node(self, node_id: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return None
+            return {"id": node.id, "type": node.label, "properties": dict(node.properties)}
+
+    def neighbors(self, node_id: str, direction: str = "both") -> list[tuple[str, str]]:
+        """[(neighbor_id, relation_label)] — direction in {out,in,both}."""
+        with self._lock:
+            out: list[tuple[str, str]] = []
+            if direction in ("out", "both"):
+                out += [(d, RelationKind(k).name) for d, k in self._out.get(node_id, ())]
+            if direction in ("in", "both"):
+                out += [(s, RelationKind(k).name) for s, k in self._in.get(node_id, ())]
+            return out
+
+    def get_incident_subgraph(self, incident_id: str, depth: int = 3) -> dict[str, Any]:
+        """Depth-limited undirected subgraph around an incident — the
+        reference's apoc.path.subgraphAll(maxLevel=depth) (neo4j.py:169-201),
+        implemented as BFS over the in-memory adjacency."""
+        nid = incident_id if incident_id.startswith("incident:") else f"incident:{incident_id}"
+        with self._lock:
+            if nid not in self._nodes:
+                return {"nodes": [], "relationships": []}
+            seen = {nid}
+            frontier = [nid]
+            for _ in range(depth):
+                nxt = []
+                for cur in frontier:
+                    for d, _k in self._out.get(cur, ()):
+                        if d not in seen:
+                            seen.add(d)
+                            nxt.append(d)
+                    for s, _k in self._in.get(cur, ()):
+                        if s not in seen:
+                            seen.add(s)
+                            nxt.append(s)
+                frontier = nxt
+                if not frontier:
+                    break
+            nodes = [
+                {"id": n.id, "type": n.label, "properties": dict(n.properties)}
+                for n in (self._nodes[i] for i in seen)
+            ]
+            rels = [
+                {"source": e.src, "target": e.dst, "type": RelationKind(e.kind).name,
+                 "properties": dict(e.properties)}
+                for e in self._edges.values()
+                if e.src in seen and e.dst in seen
+            ]
+            return {"nodes": nodes, "relationships": rels}
+
+    def find_related_changes(
+        self,
+        namespace: str,
+        window_start: datetime,
+        window_end: datetime,
+    ) -> list[dict[str, Any]]:
+        """ChangeEvents in a namespace within a time window (neo4j.py:204-228)."""
+        out = []
+        with self._lock:
+            for node in self._nodes.values():
+                if node.kind != EntityKind.CHANGE_EVENT:
+                    continue
+                props = node.properties
+                if props.get("namespace") != namespace:
+                    continue
+                ts = props.get("changed_at") or props.get("timestamp")
+                if ts is None:
+                    continue
+                when = parse_iso(ts) if isinstance(ts, str) else ts
+                if window_start <= when <= window_end:
+                    out.append({"id": node.id, "properties": dict(props)})
+        out.sort(key=lambda c: str(c["properties"].get("changed_at", "")), reverse=True)
+        return out
+
+    def find_affected_by_node(self, node_name: str) -> list[dict[str, Any]]:
+        """Pods scheduled on a node plus their owning deployments/services
+        (reference Pod→Deployment→Service traversal, neo4j.py:231-251)."""
+        target = f"node:{node_name}" if not node_name.startswith("node:") else node_name
+        results = []
+        with self._lock:
+            for src, kind in self._in.get(target, ()):
+                if kind != RelationKind.SCHEDULED_ON:
+                    continue
+                pod = self._nodes.get(src)
+                if pod is None:
+                    continue
+                owners = [
+                    self._nodes[s].id for s, k in self._in.get(src, ())
+                    if k == RelationKind.OWNS and s in self._nodes
+                ]
+                selectors = [
+                    self._nodes[s].id for s, k in self._in.get(src, ())
+                    if k == RelationKind.SELECTS and s in self._nodes
+                ]
+                results.append({
+                    "pod": pod.id,
+                    "owners": sorted(owners),
+                    "services": sorted(selectors),
+                })
+        return sorted(results, key=lambda r: r["pod"])
+
+    def get_service_dependencies(self, service_name: str) -> dict[str, list[str]]:
+        """CALLS upstream/downstream of a service (neo4j.py:254-278)."""
+        sid = service_name if service_name.startswith("service:") else f"service:{service_name}"
+        with self._lock:
+            downstream = sorted(
+                d for d, k in self._out.get(sid, ()) if k == RelationKind.CALLS
+            )
+            upstream = sorted(
+                s for s, k in self._in.get(sid, ()) if k == RelationKind.CALLS
+            )
+        return {"upstream": upstream, "downstream": downstream}
+
+    def incident_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                n.id for n in self._nodes.values() if n.kind == EntityKind.INCIDENT
+            )
+
+    # -- tensorization hooks (used by snapshot.py) ------------------------
+
+    def _raw(self) -> tuple[list[_Node], list[_Edge]]:
+        with self._lock:
+            nodes = sorted(self._nodes.values(), key=lambda n: n.index)
+            edges = list(self._edges.values())
+        return nodes, edges

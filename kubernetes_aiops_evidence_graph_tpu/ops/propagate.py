@@ -1,0 +1,78 @@
+"""K-hop propagation over the sparse evidence graph.
+
+The TPU-native answer to the reference's depth-3 Cypher traversals
+(apoc.path.subgraphAll maxLevel=3, neo4j.py:169-201) and the structural
+"long context" analog described in SURVEY.md §5: hop count × node count is
+our sequence length. Two primitives:
+
+* :func:`k_hop_reach` — batched frontier expansion (boolean BFS) from seed
+  rows, one `lax.scan` step per hop, scatter-max per step.
+* :func:`propagate_labels` — iterated normalized SpMM x ← Â·x, the batched
+  anomaly label-propagation of BASELINE.json configs[2].
+
+Both take padded COO edge lists (src, dst, mask) and run entirely under jit
+with static shapes.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .segment import scatter_add, scatter_max
+
+
+@partial(jax.jit, static_argnames=("num_nodes", "hops"))
+def k_hop_reach(
+    seed_rows: jax.Array,      # [B] node index per batch row
+    seed_mask: jax.Array,      # [B] 1.0 real / 0.0 pad
+    edge_src: jax.Array,       # [E]
+    edge_dst: jax.Array,       # [E]
+    edge_mask: jax.Array,      # [E]
+    num_nodes: int,
+    hops: int,
+) -> jax.Array:
+    """Reachability within `hops` edges: returns float [B, num_nodes]."""
+    batch = seed_rows.shape[0]
+    reach0 = jnp.zeros((batch, num_nodes), jnp.float32)
+    reach0 = reach0.at[jnp.arange(batch), seed_rows].max(seed_mask)
+
+    def step(reach, _):
+        # expand: for every edge u->v, v becomes reachable if u is
+        msg = reach[:, edge_src] * edge_mask[None, :]            # [B, E]
+        expanded = jax.vmap(
+            lambda m: scatter_max(m, edge_dst, num_nodes)
+        )(msg)
+        return jnp.maximum(reach, expanded), None
+
+    reach, _ = jax.lax.scan(step, reach0, None, length=hops)
+    return reach
+
+
+@partial(jax.jit, static_argnames=("num_nodes", "iterations"))
+def propagate_labels(
+    x: jax.Array,              # [N] or [N, D] initial scores
+    edge_src: jax.Array,
+    edge_dst: jax.Array,
+    edge_mask: jax.Array,
+    num_nodes: int,
+    iterations: int = 3,
+    alpha: float = 0.5,
+) -> jax.Array:
+    """x ← (1-α)x + α·D⁻¹Aᵀx for `iterations` rounds (label propagation)."""
+    deg = scatter_add(edge_mask, edge_dst, num_nodes)
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+
+    def step(cur, _):
+        msg = cur[edge_src]
+        if msg.ndim == 1:
+            msg = msg * edge_mask
+        else:
+            msg = msg * edge_mask[:, None]
+        agg = scatter_add(msg, edge_dst, num_nodes)
+        agg = agg * (inv_deg if agg.ndim == 1 else inv_deg[:, None])
+        return (1.0 - alpha) * cur + alpha * agg, None
+
+    out, _ = jax.lax.scan(step, x, None, length=iterations)
+    return out

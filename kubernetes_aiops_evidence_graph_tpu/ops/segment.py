@@ -1,0 +1,34 @@
+"""Sparse segment primitives — the framework's core device ops.
+
+Everything graph-shaped on TPU reduces to gather → elementwise → scatter
+(segment-sum/max). XLA lowers these to efficient TPU scatters; shapes are
+static (padded by the snapshot bucketing) so each variant compiles once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def scatter_add(values: jax.Array, index: jax.Array, num_segments: int) -> jax.Array:
+    """segment_sum: out[s] = Σ values[e] where index[e]==s. values may be
+    [E] or [E, D]; padded entries must carry zero values."""
+    out_shape = (num_segments,) + values.shape[1:]
+    return jnp.zeros(out_shape, dtype=values.dtype).at[index].add(values)
+
+
+def scatter_max(values: jax.Array, index: jax.Array, num_segments: int,
+                fill: float = 0.0) -> jax.Array:
+    out_shape = (num_segments,) + values.shape[1:]
+    return jnp.full(out_shape, fill, dtype=values.dtype).at[index].max(values)
+
+
+def scatter_add_2d(values: jax.Array, rows: jax.Array, cols: jax.Array,
+                   num_rows: int, num_cols: int) -> jax.Array:
+    """out[r, c] += v over coordinate lists (for (incident, node) pair maps)."""
+    return jnp.zeros((num_rows, num_cols), dtype=values.dtype).at[rows, cols].add(values)
+
+
+def gather_neighbors(x: jax.Array, index: jax.Array) -> jax.Array:
+    """x[index] with index padded by any in-range value (mask separately)."""
+    return x[index]

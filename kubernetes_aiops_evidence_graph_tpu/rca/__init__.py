@@ -1,0 +1,29 @@
+"""RCA engine — plugin seam between the CPU oracle and the TPU scorer
+(BASELINE.json north star: ``rca_backend={cpu|tpu}``)."""
+from __future__ import annotations
+
+from .cpu_backend import CpuRcaBackend, match_rules, rank
+from .ruleset import Cond, NUM_CONDS, NUM_RULES, RULE_INDEX, RULES, Rule
+from .signals import Signals, condition_vector, extract_signals
+
+_BACKENDS = {"cpu": CpuRcaBackend}
+
+
+def get_backend(name: str):
+    """Resolve an RCA backend by name. The TPU backend imports jax lazily so
+    CPU-only callers never pay device initialization."""
+    if name == "tpu":
+        from .tpu_backend import TpuRcaBackend
+        _BACKENDS.setdefault("tpu", TpuRcaBackend)
+        return TpuRcaBackend()
+    cls = _BACKENDS.get(name)
+    if cls is None:
+        raise KeyError(f"unknown rca backend {name!r}; available: cpu, tpu")
+    return cls()
+
+
+__all__ = [
+    "CpuRcaBackend", "get_backend", "match_rules", "rank",
+    "Cond", "NUM_CONDS", "NUM_RULES", "RULES", "RULE_INDEX", "Rule",
+    "Signals", "condition_vector", "extract_signals",
+]

@@ -1,0 +1,222 @@
+"""FakeCluster — hermetic, replayable cluster backend.
+
+The reference's only test story is live fault injection into a real
+kind/minikube cluster (src/simulator/incident_simulator.py, SURVEY.md §4).
+This FakeCluster replaces the K8s API + Loki + Prometheus trio with a
+deterministic in-memory state machine that the collectors query through the
+same backend interface they use against real endpoints — so the whole
+pipeline runs hermetically at 200 → 50k pod scale (BASELINE.json configs).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime, timedelta
+from typing import Optional
+
+from ..utils.timeutils import utcnow
+
+
+@dataclass
+class PodState:
+    name: str
+    namespace: str
+    deployment: str
+    service: str
+    node: str
+    phase: str = "Running"
+    ready: bool = True
+    restart_count: int = 0
+    waiting_reason: Optional[str] = None
+    terminated_reason: Optional[str] = None
+    not_ready_seconds: float = 0.0
+    readiness_probe_failing: bool = False
+    started_at: Optional[datetime] = None
+
+
+@dataclass
+class DeploymentState:
+    name: str
+    namespace: str
+    service: str
+    replicas: int = 3
+    ready_replicas: int = 3
+    revision: int = 1
+    image: str = "registry.local/app:v1"
+    prev_image: Optional[str] = None
+    changed_at: Optional[datetime] = None
+
+
+@dataclass
+class NodeState:
+    name: str
+    # condition -> "True"/"False"; Ready defaults True, pressures default False
+    conditions: dict[str, str] = field(default_factory=lambda: {"Ready": "True"})
+
+
+@dataclass
+class ServiceState:
+    name: str
+    namespace: str
+    deployment: str
+    calls: list[str] = field(default_factory=list)  # downstream service names
+
+
+@dataclass
+class HPAState:
+    name: str
+    namespace: str
+    deployment: str
+    min_replicas: int = 1
+    max_replicas: int = 10
+    current_replicas: int = 3
+    at_max: bool = False
+
+
+@dataclass
+class ConfigMapState:
+    name: str
+    namespace: str
+    changed_at: Optional[datetime] = None
+    mounted_by: list[str] = field(default_factory=list)  # deployment names
+
+
+@dataclass
+class EventState:
+    namespace: str
+    involved_object: str
+    reason: str
+    type: str = "Warning"
+    message: str = ""
+    timestamp: Optional[datetime] = None
+
+
+@dataclass
+class ServiceMetrics:
+    memory_pct: float = 55.0
+    error_rate: float = 0.001
+    p99_latency_s: float = 0.12
+    cpu_throttle_ratio: float = 0.02
+    oom_events: float = 0.0
+    restarts_rate: float = 0.0
+    hpa_at_max: float = 0.0  # 0/1 gauge
+
+
+class FakeCluster:
+    """In-memory cluster implementing the ClusterBackend query surface."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self.pods: dict[str, PodState] = {}
+        self.deployments: dict[str, DeploymentState] = {}
+        self.nodes: dict[str, NodeState] = {}
+        self.services: dict[str, ServiceState] = {}
+        self.hpas: dict[str, HPAState] = {}
+        self.configmaps: dict[str, ConfigMapState] = {}
+        self.events: list[EventState] = []
+        self.pod_logs: dict[str, list[str]] = {}
+        self.metrics: dict[str, ServiceMetrics] = {}
+        self.now: datetime = utcnow()
+
+    # -- keys -------------------------------------------------------------
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    # -- ClusterBackend query surface (used by collectors) ----------------
+
+    def list_pods(self, namespace: str, service: str | None = None) -> list[PodState]:
+        out = [
+            p for p in self.pods.values()
+            if p.namespace == namespace and (service is None or p.service == service)
+        ]
+        return sorted(out, key=lambda p: p.name)
+
+    def list_deployments(self, namespace: str, service: str | None = None) -> list[DeploymentState]:
+        out = [
+            d for d in self.deployments.values()
+            if d.namespace == namespace and (service is None or d.service == service)
+        ]
+        return sorted(out, key=lambda d: d.name)
+
+    def list_nodes(self) -> list[NodeState]:
+        return sorted(self.nodes.values(), key=lambda n: n.name)
+
+    def list_hpas(self, namespace: str, service: str | None = None) -> list[HPAState]:
+        out = [
+            h for h in self.hpas.values()
+            if h.namespace == namespace
+            and (service is None or self.deployments.get(self._key(namespace, h.deployment),
+                                                         DeploymentState("", "", "")).service == service)
+        ]
+        return sorted(out, key=lambda h: h.name)
+
+    def list_configmaps(self, namespace: str) -> list[ConfigMapState]:
+        return sorted(
+            (c for c in self.configmaps.values() if c.namespace == namespace),
+            key=lambda c: c.name,
+        )
+
+    def list_events(self, namespace: str, since: datetime) -> list[EventState]:
+        return [
+            e for e in self.events
+            if e.namespace == namespace and e.timestamp is not None and e.timestamp >= since
+        ]
+
+    def query_logs(self, namespace: str, service: str, limit: int = 1000) -> list[str]:
+        """Loki query_range analog: newest-first lines for a service's pods
+        (logs_collector.py:80-116)."""
+        lines: list[str] = []
+        for p in self.list_pods(namespace, service):
+            lines.extend(self.pod_logs.get(self._key(namespace, p.name), ()))
+        return lines[-limit:][::-1]
+
+    def query_metric(self, namespace: str, service: str, query_name: str) -> float | None:
+        """Prometheus instant-value analog, keyed by query name."""
+        m = self.metrics.get(self._key(namespace, service))
+        if m is None:
+            return None
+        table = {
+            "memory_usage_pct": m.memory_pct,
+            "error_rate": m.error_rate,
+            "latency_p99_seconds": m.p99_latency_s,
+            "cpu_throttle_ratio": m.cpu_throttle_ratio,
+            "oom_events": m.oom_events,
+            "pod_restarts": m.restarts_rate,
+            "hpa_at_max": m.hpa_at_max,
+        }
+        return table.get(query_name)
+
+    def rollout_history(self, namespace: str, deployment: str) -> list[dict]:
+        d = self.deployments.get(self._key(namespace, deployment))
+        if d is None:
+            return []
+        hist = [{
+            "revision": d.revision,
+            "image": d.image,
+            "changed_at": d.changed_at,
+        }]
+        if d.prev_image is not None:
+            hist.append({
+                "revision": d.revision - 1,
+                "image": d.prev_image,
+                "changed_at": None,
+            })
+        return hist
+
+    # -- mutation helpers used by scenarios/stream ------------------------
+
+    def add_event(self, namespace: str, obj: str, reason: str, message: str = "") -> None:
+        self.events.append(EventState(
+            namespace=namespace, involved_object=obj, reason=reason,
+            message=message, timestamp=self.now,
+        ))
+
+    def set_logs(self, namespace: str, pod: str, lines: list[str]) -> None:
+        self.pod_logs[self._key(namespace, pod)] = lines
+
+    def service_metrics(self, namespace: str, service: str) -> ServiceMetrics:
+        return self.metrics.setdefault(self._key(namespace, service), ServiceMetrics())
+
+    def advance(self, seconds: float) -> None:
+        self.now = self.now + timedelta(seconds=seconds)

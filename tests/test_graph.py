@@ -1,0 +1,153 @@
+from datetime import timedelta
+
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import (
+    EntityKind, EvidenceGraphStore, F, GraphBuilder, RelationKind, build_snapshot,
+)
+from kubernetes_aiops_evidence_graph_tpu.models import (
+    CollectorResult, Evidence, EvidenceSource, EvidenceType, GraphEntity,
+    GraphRelation, Incident, Severity, utcnow,
+)
+
+SMALL = load_settings(
+    node_bucket_sizes=(16, 64), edge_bucket_sizes=(32, 128), incident_bucket_sizes=(4, 8),
+)
+
+
+def _mini_store() -> EvidenceGraphStore:
+    s = EvidenceGraphStore()
+    s.upsert_entities([
+        GraphEntity(id="incident:i1", type="Incident", properties={"title": "t"}),
+        GraphEntity(id="pod:default:api-1", type="Pod",
+                    properties={"waiting_reason": "CrashLoopBackOff", "restart_count": 7}),
+        GraphEntity(id="node:n1", type="Node",
+                    properties={"conditions": {"Ready": {"status": "False"},
+                                               "MemoryPressure": {"status": "True"}}}),
+        GraphEntity(id="deployment:default:api", type="Deployment"),
+        GraphEntity(id="service:default:api", type="Service"),
+    ])
+    s.upsert_relations([
+        GraphRelation(source_id="incident:i1", target_id="pod:default:api-1", relation_type="AFFECTS"),
+        GraphRelation(source_id="pod:default:api-1", target_id="node:n1", relation_type="SCHEDULED_ON"),
+        GraphRelation(source_id="deployment:default:api", target_id="pod:default:api-1", relation_type="OWNS"),
+        GraphRelation(source_id="service:default:api", target_id="pod:default:api-1", relation_type="SELECTS"),
+    ])
+    return s
+
+
+def test_store_merge_semantics():
+    s = _mini_store()
+    n0, e0 = s.node_count(), s.edge_count()
+    # re-upsert merges properties, doesn't duplicate
+    s.upsert_entities([GraphEntity(id="pod:default:api-1", type="Pod",
+                                   properties={"restart_count": 9})])
+    s.upsert_relations([GraphRelation(source_id="incident:i1", target_id="pod:default:api-1",
+                                      relation_type="AFFECTS", properties={"w": 1})])
+    assert s.node_count() == n0 and s.edge_count() == e0
+    assert s.get_node("pod:default:api-1")["properties"]["restart_count"] == 9
+    assert s.get_node("pod:default:api-1")["properties"]["waiting_reason"] == "CrashLoopBackOff"
+
+
+def test_subgraph_depth_semantics():
+    s = _mini_store()
+    g1 = s.get_incident_subgraph("i1", depth=1)
+    assert {n["id"] for n in g1["nodes"]} == {"incident:i1", "pod:default:api-1"}
+    g2 = s.get_incident_subgraph("i1", depth=2)
+    assert {n["id"] for n in g2["nodes"]} == {
+        "incident:i1", "pod:default:api-1", "node:n1",
+        "deployment:default:api", "service:default:api",
+    }
+    # relationship list is restricted to the subgraph
+    assert all(r["source"] in {n["id"] for n in g2["nodes"]} for r in g2["relationships"])
+
+
+def test_affected_by_node_and_service_deps():
+    s = _mini_store()
+    s.upsert_relations([
+        GraphRelation(source_id="service:default:web", target_id="service:default:api",
+                      relation_type="CALLS"),
+    ])
+    affected = s.find_affected_by_node("n1")
+    assert affected == [{
+        "pod": "pod:default:api-1",
+        "owners": ["deployment:default:api"],
+        "services": ["service:default:api"],
+    }]
+    deps = s.get_service_dependencies("default:api")
+    assert deps == {"upstream": ["service:default:web"], "downstream": []}
+
+
+def test_cleanup_incident():
+    s = _mini_store()
+    assert s.cleanup_incident("i1") == 1
+    assert s.get_node("incident:i1") is None
+    assert s.get_incident_subgraph("i1")["nodes"] == []
+    # dense indices reassigned → snapshot still coherent
+    snap = build_snapshot(s, SMALL)
+    assert snap.num_nodes == 4 and snap.num_incidents == 0
+
+
+def test_related_changes_window():
+    s = EvidenceGraphStore()
+    now = utcnow()
+    s.upsert_entities([
+        GraphEntity(id="change:default:api:5", type="ChangeEvent",
+                    properties={"namespace": "default",
+                                "changed_at": (now - timedelta(minutes=10)).isoformat()}),
+        GraphEntity(id="change:default:api:4", type="ChangeEvent",
+                    properties={"namespace": "default",
+                                "changed_at": (now - timedelta(hours=3)).isoformat()}),
+    ])
+    hits = s.find_related_changes("default", now - timedelta(minutes=30), now)
+    assert [h["id"] for h in hits] == ["change:default:api:5"]
+
+
+def test_snapshot_tensorization():
+    s = _mini_store()
+    snap = build_snapshot(s, SMALL)
+    assert snap.num_nodes == 5 and snap.padded_nodes == 16
+    assert snap.num_edges == 8  # 4 undirected edges → 8 directed
+    assert snap.node_mask.sum() == 5 and snap.edge_mask.sum() == 8
+    assert snap.num_incidents == 1 and snap.padded_incidents == 4
+
+    pod = snap.index_of("pod:default:api-1")
+    assert snap.features[pod, F.W_CRASHLOOPBACKOFF] == 1.0
+    assert snap.features[pod, F.RESTART_COUNT] == 7.0
+    node = snap.index_of("node:n1")
+    assert snap.features[node, F.NODE_NOT_READY] == 1.0
+    assert snap.features[node, F.NODE_MEMORY_PRESSURE] == 1.0
+    assert snap.node_kind[node] == int(EntityKind.NODE)
+
+    src, dst = snap.typed_edges(RelationKind.AFFECTS)
+    assert len(src) == 2  # both directions
+    # padded tail is masked
+    assert snap.edge_rel[snap.num_edges:].max() == -1
+
+
+def test_builder_ingest_applies_evidence():
+    inc = Incident(fingerprint="fp", title="crash", severity=Severity.CRITICAL,
+                   namespace="default", service="api")
+    b = GraphBuilder()
+    res = CollectorResult(
+        collector_name="kubernetes",
+        evidence=[Evidence(
+            incident_id=inc.id, evidence_type=EvidenceType.KUBERNETES_POD,
+            source=EvidenceSource.KUBERNETES_API, entity_name="api-1",
+            entity_namespace="default",
+            data={"waiting_reason": "CrashLoopBackOff", "restart_count": 5},
+            signal_strength=0.95,
+        )],
+        entities=[GraphEntity(id="pod:default:api-1", type="Pod")],
+        relations=[],
+    )
+    stats = b.ingest(inc, [res])
+    assert stats["evidence"] == 1
+    snap = build_snapshot(b.store, SMALL)
+    pod = snap.index_of("pod:default:api-1")
+    assert snap.features[pod, F.W_CRASHLOOPBACKOFF] == 1.0
+    assert snap.features[pod, F.SIGNAL_STRENGTH] == np.float32(0.95)
+    # AFFECTS edge auto-created incident -> pod
+    src, dst = snap.typed_edges(RelationKind.AFFECTS)
+    assert len(src) == 2

@@ -1,0 +1,112 @@
+"""CPU-vs-TPU parity: same simulated cluster → same top-1 rule and scores.
+
+This is the matched-accuracy requirement from BASELINE.json: the TPU
+backend must reproduce the CPU oracle's top-1 hypothesis on identical
+snapshots, across every scenario and on mixed multi-incident clusters.
+"""
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder, build_snapshot
+from kubernetes_aiops_evidence_graph_tpu.rca import RULES, RULE_INDEX, get_backend
+from kubernetes_aiops_evidence_graph_tpu.simulator import SCENARIOS, generate_cluster, inject
+
+SMALL = load_settings(
+    node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+    incident_bucket_sizes=(8, 32),
+)
+
+
+def run_pipeline(scenario_names, num_pods=200, seed=7):
+    """Simulate scenarios on one cluster; return (evidence per incident, snapshot)."""
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    deploy_keys = sorted(cluster.deployments)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    incidents, evidence_by_incident = [], {}
+    for i, name in enumerate(scenario_names):
+        target = deploy_keys[(i * 7) % len(deploy_keys)]
+        incident = inject(cluster, name, target, rng)
+        incidents.append(incident)
+    # collect AFTER all injections so both backends see one consistent state
+    for incident in incidents:
+        results = collect_all(incident, default_collectors(cluster, SMALL), parallel=False)
+        builder.ingest(incident, results)
+        evidence_by_incident[incident.id] = [
+            ev.model_dump(mode="json") for r in results for ev in r.evidence
+        ]
+    snapshot = build_snapshot(builder.store, SMALL, now_s=cluster.now.timestamp())
+    return incidents, evidence_by_incident, snapshot
+
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIOS))
+def test_scenario_top1_matches_expectation_and_parity(scenario):
+    incidents, evidence, snapshot = run_pipeline([scenario])
+    incident = incidents[0]
+
+    cpu = get_backend("cpu")
+    cpu_result = cpu.score_incident(incident.id, evidence[incident.id])
+    expected_rule = SCENARIOS[scenario].expected_rule
+    assert cpu_result.top_hypothesis.rule_id == expected_rule, (
+        f"CPU oracle: expected {expected_rule}, got {cpu_result.top_hypothesis.rule_id} "
+        f"(matched={cpu_result.rules_matched})"
+    )
+
+    tpu = get_backend("tpu")
+    raw = tpu.score_snapshot(snapshot)
+    assert raw["incident_ids"][0].endswith(str(incident.id))
+    assert bool(raw["any_match"][0])
+    top_rule = RULES[int(raw["top_rule_index"][0])]
+    assert top_rule.id == expected_rule, (
+        f"TPU: expected {expected_rule}, got {top_rule.id} "
+        f"(conds={raw['conditions'][0].nonzero()})"
+    )
+    # exact score parity (constant-folded scores on both sides)
+    assert float(raw["top_confidence"][0]) == pytest.approx(
+        cpu_result.top_hypothesis.confidence, abs=1e-6)
+    assert float(raw["top_score"][0]) == pytest.approx(
+        cpu_result.top_hypothesis.final_score, abs=1e-6)
+
+
+def test_mixed_incidents_batch_parity():
+    names = sorted(SCENARIOS)  # all 10 at once on one cluster
+    incidents, evidence, snapshot = run_pipeline(names, num_pods=400, seed=11)
+    cpu = get_backend("cpu")
+    tpu = get_backend("tpu")
+    raw = tpu.score_snapshot(snapshot)
+    by_node_id = {nid: i for i, nid in enumerate(raw["incident_ids"])}
+    agree = 0
+    for incident in incidents:
+        cpu_top = cpu.score_incident(incident.id, evidence[incident.id]).top_hypothesis
+        row = by_node_id[f"incident:{incident.id}"]
+        if raw["any_match"][row]:
+            tpu_rule = RULES[int(raw["top_rule_index"][row])].id
+        else:
+            tpu_rule = "unknown"
+        assert tpu_rule == cpu_top.rule_id, (
+            f"{incident.labels['scenario']}: cpu={cpu_top.rule_id} tpu={tpu_rule}"
+        )
+        agree += 1
+    assert agree == len(incidents)
+
+
+def test_no_evidence_incident_is_unknown():
+    from uuid import uuid4
+    cpu = get_backend("cpu")
+    res = cpu.score_incident(uuid4(), [])
+    assert res.top_hypothesis.rule_id == "unknown"
+    assert res.top_hypothesis.confidence == 0.3
+    assert res.top_hypothesis.final_score == 0.15
+
+
+def test_tpu_results_materialization():
+    incidents, _, snapshot = run_pipeline(["oom"])
+    tpu = get_backend("tpu")
+    results = tpu.results(snapshot)
+    assert len(results) == 1
+    top = results[0].top_hypothesis
+    assert top.rule_id == "oom_killed" and top.backend == "tpu"
+    assert top.rank == 1
+    assert RULE_INDEX[top.rule_id] == 2
