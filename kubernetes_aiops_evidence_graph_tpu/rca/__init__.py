@@ -6,20 +6,24 @@ from .cpu_backend import CpuRcaBackend, match_rules, rank
 from .ruleset import Cond, NUM_CONDS, NUM_RULES, RULE_INDEX, RULES, Rule
 from .signals import Signals, condition_vector, extract_signals
 
-_BACKENDS = {"cpu": CpuRcaBackend}
+_BACKEND_CLASSES = {"cpu": CpuRcaBackend}
+_INSTANCES: dict[str, object] = {}
 
 
 def get_backend(name: str):
-    """Resolve an RCA backend by name. The TPU backend imports jax lazily so
-    CPU-only callers never pay device initialization."""
+    """Resolve an RCA backend by name — memoized so the TPU backend's
+    device-resident snapshot cache survives across calls. The TPU class
+    imports jax lazily so CPU-only callers never pay device init."""
+    inst = _INSTANCES.get(name)
+    if inst is not None:
+        return inst
     if name == "tpu":
         from .tpu_backend import TpuRcaBackend
-        _BACKENDS.setdefault("tpu", TpuRcaBackend)
-        return TpuRcaBackend()
-    cls = _BACKENDS.get(name)
+        _BACKEND_CLASSES.setdefault("tpu", TpuRcaBackend)
+    cls = _BACKEND_CLASSES.get(name)
     if cls is None:
         raise KeyError(f"unknown rca backend {name!r}; available: cpu, tpu")
-    return cls()
+    return _INSTANCES.setdefault(name, cls())
 
 
 __all__ = [

@@ -1,0 +1,128 @@
+"""Learnable GNN RCA scorer — the framework's flagship model.
+
+A KGroot-style graph-convolutional scorer (PAPERS.md: KGroot, GCN-based RCA)
+over the tensorized evidence graph: node features + entity-kind embeddings,
+K rounds of segment-sum message passing, incident-node readout to rule
+logits (NUM_RULES + 1 classes, last = unknown). Complements the
+deterministic ruleset backend with a trainable one
+(HypothesisSource.GNN); simulator scenarios provide labeled training data.
+
+Pure-JAX pytree parameters (no flax dependency in the hot path); the math
+lives here device-agnostic, the multi-chip sharded training step lives in
+``parallel/sharded_gnn.py``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..graph.schema import DIM
+from .ruleset import NUM_RULES
+
+NUM_CLASSES = NUM_RULES + 1   # + unknown
+NUM_KINDS = 11                # graph.schema.EntityKind members
+
+Params = dict[str, Any]
+
+
+def init_params(key: jax.Array, hidden: int = 64, layers: int = 3) -> Params:
+    keys = jax.random.split(key, 3 + 2 * layers)
+    scale = lambda fan_in: 1.0 / jnp.sqrt(fan_in)
+    params: Params = {
+        "embed_w": jax.random.normal(keys[0], (DIM, hidden)) * scale(DIM),
+        "embed_b": jnp.zeros((hidden,)),
+        "kind_emb": jax.random.normal(keys[1], (NUM_KINDS, hidden)) * 0.1,
+        "head_w": jax.random.normal(keys[2], (hidden, NUM_CLASSES)) * scale(hidden),
+        "head_b": jnp.zeros((NUM_CLASSES,)),
+        "layers": [],
+    }
+    for i in range(layers):
+        params["layers"].append({
+            "w_self": jax.random.normal(keys[3 + 2 * i], (hidden, hidden)) * scale(hidden),
+            "w_msg": jax.random.normal(keys[4 + 2 * i], (hidden, hidden)) * scale(hidden),
+            "b": jnp.zeros((hidden,)),
+        })
+    return params
+
+
+def _message_pass(h, layer, edge_src, edge_dst, edge_mask, inv_deg):
+    """One GCN round: normalized segment-sum aggregation + residual."""
+    msg = h[edge_src] * edge_mask[:, None]
+    agg = jnp.zeros_like(h).at[edge_dst].add(msg) * inv_deg[:, None]
+    return jax.nn.relu(h @ layer["w_self"] + agg @ layer["w_msg"] + layer["b"]) + h
+
+
+def forward(
+    params: Params,
+    features: jax.Array,        # [N, DIM] f32
+    node_kind: jax.Array,       # [N] i32
+    node_mask: jax.Array,       # [N] f32
+    edge_src: jax.Array,        # [E] i32
+    edge_dst: jax.Array,        # [E] i32
+    edge_mask: jax.Array,       # [E] f32
+    incident_nodes: jax.Array,  # [B] i32
+) -> jax.Array:
+    """Logits [B, NUM_CLASSES] for each incident node."""
+    deg = jnp.zeros(features.shape[0], features.dtype).at[edge_dst].add(edge_mask)
+    inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+    h = jax.nn.relu(features @ params["embed_w"] + params["embed_b"]
+                    + params["kind_emb"][node_kind])
+    h = h * node_mask[:, None]
+    for layer in params["layers"]:
+        h = _message_pass(h, layer, edge_src, edge_dst, edge_mask, inv_deg)
+    return h[incident_nodes] @ params["head_w"] + params["head_b"]
+
+
+def loss_fn(
+    params: Params,
+    features, node_kind, node_mask, edge_src, edge_dst, edge_mask,
+    incident_nodes, labels, label_mask,
+) -> jax.Array:
+    """Masked mean cross-entropy over incident rows."""
+    logits = forward(params, features, node_kind, node_mask,
+                     edge_src, edge_dst, edge_mask, incident_nodes)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+    return (nll * label_mask).sum() / jnp.maximum(label_mask.sum(), 1.0)
+
+
+def make_train_step(tx):
+    """Single-device train step (optax transform tx); the sharded variant is
+    parallel.sharded_gnn.make_sharded_train_step."""
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params,
+            batch["features"], batch["node_kind"], batch["node_mask"],
+            batch["edge_src"], batch["edge_dst"], batch["edge_mask"],
+            batch["incident_nodes"], batch["labels"], batch["label_mask"],
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def snapshot_batch(snapshot, labels=None) -> dict:
+    """Pack a GraphSnapshot (+ optional int labels per incident) into the
+    array batch consumed by forward/loss."""
+    import numpy as np
+    n_inc = snapshot.padded_incidents
+    lab = np.full(n_inc, NUM_CLASSES - 1, dtype=np.int32)
+    if labels is not None:
+        lab[:len(labels)] = np.asarray(labels, dtype=np.int32)
+    return {
+        "features": snapshot.features,
+        "node_kind": snapshot.node_kind,
+        "node_mask": snapshot.node_mask,
+        "edge_src": snapshot.edge_src,
+        "edge_dst": snapshot.edge_dst,
+        "edge_mask": snapshot.edge_mask,
+        "incident_nodes": snapshot.incident_nodes,
+        "labels": lab,
+        "label_mask": snapshot.incident_mask,
+    }

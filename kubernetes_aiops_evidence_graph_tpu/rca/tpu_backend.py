@@ -96,33 +96,22 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     ev_dst = dst[is_ev].astype(np.int64)
 
     # join incident->pod with pod->node (SCHEDULED_ON, original direction =
-    # pod side is src)
-    is_sched = rel == int(RelationKind.SCHEDULED_ON)
-    sched_src = src[is_sched]
-    sched_dst = dst[is_sched]
-    # keep direction pod->node: pods are never scheduled-on targets, so a
-    # reversed duplicate has a node as src; filter by feature-agnostic check:
-    # builder only creates pod->node, so reversed pairs have src that appears
-    # as a dst in the original set. Use id-kind via snapshot.node_kind.
+    # pod side is src; reversed duplicates have a Node as src) — fully
+    # vectorized numpy hash-free join via a node_of_pod lookup table
     from ..graph.schema import EntityKind
-    pod_side = snapshot.node_kind[sched_src] == int(EntityKind.POD)
-    sched_src = sched_src[pod_side]
-    sched_dst = sched_dst[pod_side]
-    pod_to_node = dict(zip(sched_src.tolist(), sched_dst.tolist()))
+    is_sched = rel == int(RelationKind.SCHEDULED_ON)
+    pod_side = is_sched & (snapshot.node_kind[src] == int(EntityKind.POD))
+    node_of_pod = np.full(snapshot.padded_nodes, -1, dtype=np.int64)
+    node_of_pod[src[pod_side]] = dst[pod_side]
 
-    pr_rows: list[int] = []
-    pr_pods: list[int] = []
-    pr_nodes: list[int] = []
-    for row, pod in zip(ev_rows.tolist(), ev_dst.tolist()):
-        node = pod_to_node.get(pod)
-        if node is not None:
-            pr_rows.append(row)
-            pr_pods.append(pod)
-            pr_nodes.append(node)
+    on_node = node_of_pod[ev_dst] >= 0
+    pr_rows = ev_rows[on_node]
+    pr_pods = ev_dst[on_node]
+    pr_nodes = node_of_pod[ev_dst[on_node]]
 
     # compact (row, node) pairs
-    if pr_rows:
-        pair_key = np.asarray(pr_rows, dtype=np.int64) << 32 | np.asarray(pr_nodes, dtype=np.int64)
+    if len(pr_rows):
+        pair_key = pr_rows.astype(np.int64) << 32 | pr_nodes
         uniq, pair_ids = np.unique(pair_key, return_inverse=True)
         pair_rows_real = (uniq >> 32).astype(np.int32)
     else:
@@ -225,9 +214,37 @@ def _score_device(
 
 
 class TpuRcaBackend:
-    """rca_backend="tpu" — batched scoring over a GraphSnapshot."""
+    """rca_backend="tpu" — batched scoring over a GraphSnapshot.
+
+    Device arrays are cached per snapshot version: re-scoring the same
+    snapshot (the steady-state of the streaming path) re-uses resident HBM
+    buffers and skips host prep entirely.
+    """
 
     name = "tpu"
+
+    def __init__(self) -> None:
+        self._cached_snapshot: GraphSnapshot | None = None  # strong ref: keeps
+        # id()s from being reused while the cache lives
+        self._device_args: tuple | None = None
+        self._batch: DeviceBatch | None = None
+
+    def _load(self, snapshot: GraphSnapshot) -> tuple[DeviceBatch, tuple, float]:
+        if self._cached_snapshot is snapshot and self._device_args is not None:
+            return self._batch, self._device_args, 0.0
+        t0 = time.perf_counter()
+        batch = prepare_batch(snapshot)
+        args = (
+            jnp.asarray(batch.features),
+            jnp.asarray(batch.ev_rows), jnp.asarray(batch.ev_dst),
+            jnp.asarray(batch.ev_mask),
+            jnp.asarray(batch.pair_ids), jnp.asarray(batch.pair_pod),
+            jnp.asarray(batch.pair_mask),
+            jnp.asarray(batch.pair_rows), jnp.asarray(batch.pair_rows_mask),
+        )
+        jax.block_until_ready(args)
+        self._cached_snapshot, self._batch, self._device_args = snapshot, batch, args
+        return batch, args, time.perf_counter() - t0
 
     def score_snapshot(self, snapshot: GraphSnapshot) -> dict:
         """Score every incident in the snapshot in one device pass.
@@ -235,36 +252,28 @@ class TpuRcaBackend:
         Returns a dict of host numpy arrays keyed by incident order
         (snapshot.incident_ids); use :meth:`results` for model objects.
         """
-        t0 = time.perf_counter()
-        batch = prepare_batch(snapshot)
-        prep_s = time.perf_counter() - t0
+        batch, args, prep_s = self._load(snapshot)
 
         t1 = time.perf_counter()
-        conds, matched, scores, top_idx, any_match, top_conf, top_score = (
-            _score_device(
-                jnp.asarray(batch.features),
-                jnp.asarray(batch.ev_rows), jnp.asarray(batch.ev_dst),
-                jnp.asarray(batch.ev_mask),
-                jnp.asarray(batch.pair_ids), jnp.asarray(batch.pair_pod),
-                jnp.asarray(batch.pair_mask),
-                jnp.asarray(batch.pair_rows), jnp.asarray(batch.pair_rows_mask),
-                padded_incidents=batch.padded_incidents,
-                num_pairs=int(batch.pair_rows.shape[0]),
-            )
+        out = _score_device(
+            *args,
+            padded_incidents=batch.padded_incidents,
+            num_pairs=int(batch.pair_rows.shape[0]),
         )
-        top_idx = np.asarray(top_idx)
+        conds, matched, scores, top_idx, any_match, top_conf, top_score = (
+            jax.device_get(out))  # one batched readback
         device_s = time.perf_counter() - t1
 
         n = snapshot.num_incidents
         return {
             "incident_ids": snapshot.incident_ids,
-            "conditions": np.asarray(conds)[:n],
-            "matched": np.asarray(matched)[:n],
-            "scores": np.asarray(scores)[:n],
+            "conditions": conds[:n],
+            "matched": matched[:n],
+            "scores": scores[:n],
             "top_rule_index": top_idx[:n],
-            "any_match": np.asarray(any_match)[:n],
-            "top_confidence": np.asarray(top_conf)[:n],
-            "top_score": np.asarray(top_score)[:n],
+            "any_match": any_match[:n],
+            "top_confidence": top_conf[:n],
+            "top_score": top_score[:n],
             "prep_seconds": prep_s,
             "device_seconds": device_s,
         }

@@ -116,6 +116,8 @@ class FakeCluster:
         self.pod_logs: dict[str, list[str]] = {}
         self.metrics: dict[str, ServiceMetrics] = {}
         self.now: datetime = utcnow()
+        self._pod_index: dict[tuple[str, str], list[PodState]] | None = None
+        self._pod_index_size: int = -1
 
     # -- keys -------------------------------------------------------------
 
@@ -125,11 +127,29 @@ class FakeCluster:
 
     # -- ClusterBackend query surface (used by collectors) ----------------
 
+    def invalidate_index(self) -> None:
+        """Drop the service index. Adds/removes are auto-detected by size;
+        call this only when *replacing* a pod under the same key."""
+        self._pod_index = None
+
+    def _pods_by_service(self) -> dict[tuple[str, str], list[PodState]]:
+        # auto-invalidate when pods were added/removed (size change); scenario
+        # code mutates existing PodState objects in place, which needs no
+        # invalidation because the index holds object references
+        if self._pod_index is None or self._pod_index_size != len(self.pods):
+            idx: dict[tuple[str, str], list[PodState]] = {}
+            for p in self.pods.values():
+                idx.setdefault((p.namespace, p.service), []).append(p)
+            for lst in idx.values():
+                lst.sort(key=lambda p: p.name)
+            self._pod_index = idx
+            self._pod_index_size = len(self.pods)
+        return self._pod_index
+
     def list_pods(self, namespace: str, service: str | None = None) -> list[PodState]:
-        out = [
-            p for p in self.pods.values()
-            if p.namespace == namespace and (service is None or p.service == service)
-        ]
+        if service is not None:
+            return list(self._pods_by_service().get((namespace, service), ()))
+        out = [p for p in self.pods.values() if p.namespace == namespace]
         return sorted(out, key=lambda p: p.name)
 
     def list_deployments(self, namespace: str, service: str | None = None) -> list[DeploymentState]:

@@ -1,9 +1,18 @@
-"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import
-so multi-chip sharding paths are exercised without TPU hardware."""
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding paths are exercised hermetically (no TPU/tunnel dependency).
+
+Note: this environment ships an `axon` TPU plugin that overrides
+JAX_PLATFORMS at import time, so the env var alone is not enough — we must
+set XLA_FLAGS before import and switch platforms via jax.config after.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
