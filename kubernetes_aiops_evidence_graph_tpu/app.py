@@ -1,0 +1,126 @@
+"""Application composition root.
+
+Wires the whole platform together — storage, graph store, dedup, rate
+limiting, workflow worker (asyncio loop on a background thread), HTTP API —
+the role docker-compose's aiops-api + aiops-worker pair plays for the
+reference (docker-compose.yml:205-253), in one process with no external
+services. Also the fix for reference defect 1: `uvicorn src.main:app`
+pointed at a module that didn't exist; here `python -m
+kubernetes_aiops_evidence_graph_tpu.serve` works.
+"""
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional
+from uuid import UUID
+
+from .config import Settings, get_settings
+from .graph import GraphBuilder
+from .ingestion.api import make_server
+from .ingestion.dedup import AlertDeduplicator, RateLimiter
+from .models import Incident, IncidentCreate
+from .observability import ALERTS_DEDUPLICATED, INCIDENTS_CREATED, configure, get_logger
+from .storage import Database, DuplicateIncidentError
+from .workflow import IncidentWorker, WorkflowEngine
+
+log = get_logger("app")
+
+
+class AiopsApp:
+    def __init__(
+        self,
+        cluster: Any,
+        settings: Settings | None = None,
+        db: Database | None = None,
+    ) -> None:
+        self.settings = settings or get_settings()
+        configure(self.settings.log_level)
+        self.cluster = cluster
+        self.db = db or Database(self.settings.db_path)
+        self.builder = GraphBuilder()
+        self.store = self.builder.store
+        self.dedup = AlertDeduplicator(self.settings)
+        self.rate_limiter = RateLimiter(self.settings)
+        self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
+                                     settings=self.settings)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._loop_thread: threading.Thread | None = None
+        self._server = None
+        self._server_thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, host: str | None = None, port: int | None = None) -> int:
+        """Start worker loop + HTTP server; returns the bound port."""
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop.run_forever, daemon=True, name="kaeg-worker-loop")
+        self._loop_thread.start()
+        asyncio.run_coroutine_threadsafe(self.worker.start(), self._loop).result()
+
+        self._server = make_server(
+            self, host or self.settings.api_host,
+            self.settings.api_port if port is None else port)
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="kaeg-http")
+        self._server_thread.start()
+        bound = self._server.server_address[1]
+        log.info("app_started", port=bound)
+        return bound
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        if self._loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self.worker.drain(), self._loop).result(timeout=30)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(timeout=5)
+            self._loop = None
+        self.db.close()
+
+    def ready(self) -> bool:
+        try:
+            self.db.query("SELECT 1")
+            return self._loop is not None and self._loop.is_running()
+        except Exception:
+            return False
+
+    # -- ingestion path (main.py:345-425 analog) --------------------------
+
+    def ingest(self, spec: IncidentCreate) -> Optional[str]:
+        """Normalize→dedup→persist→launch workflow. Returns incident id or
+        None when deduplicated."""
+        if self.dedup.check_duplicate(spec.fingerprint):
+            ALERTS_DEDUPLICATED.inc(reason="ttl")
+            return None
+        incident = Incident(**spec.model_dump())
+        try:
+            self.db.create_incident(incident)
+        except DuplicateIncidentError:
+            ALERTS_DEDUPLICATED.inc(reason="storage")  # backstop (init-db.sql:27)
+            return None
+        self.dedup.register_fingerprint(spec.fingerprint)  # fixes defect 4
+        INCIDENTS_CREATED.inc(severity=incident.severity.value)
+        if self._loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self.worker.submit(incident), self._loop)
+        return str(incident.id)
+
+    def workflow_status(self, incident_id: str | UUID) -> dict:
+        return self.worker.engine.status(f"incident-{incident_id}")
+
+
+def main() -> None:  # pragma: no cover - manual entrypoint
+    """Serve against a simulated cluster (hermetic demo mode)."""
+    from .simulator import generate_cluster
+    settings = get_settings()
+    app = AiopsApp(generate_cluster(num_pods=200, seed=0), settings)
+    port = app.start()
+    print(f"kaeg-tpu serving on :{port} (Ctrl-C to stop)")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        app.stop()

@@ -127,11 +127,12 @@ class KubernetesCollector(BaseCollector):
             ready = n.conditions.get("Ready", "True")
             pressures = {
                 k: v for k, v in n.conditions.items()
-                if k in ("MemoryPressure", "DiskPressure", "PIDPressure", "NetworkUnavailable")
+                if k in ("MemoryPressure", "DiskPressure", "PIDPressure",
+                         "NetworkUnavailable", "Unschedulable")
                 and v == "True"
             }
             if ready == "True" and not pressures:
-                continue  # only unhealthy nodes are evidence (:504-557)
+                continue  # only unhealthy/cordoned nodes are evidence (:504-557)
             data = {"name": n.name, "conditions": {k: {"status": v} for k, v in n.conditions.items()}}
             result.evidence.append(self.make_evidence(
                 incident, EvidenceType.KUBERNETES_NODE, n.name, data,

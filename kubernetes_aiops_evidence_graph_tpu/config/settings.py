@@ -75,6 +75,8 @@ class Settings:
     slack_channel: str = "#incidents"
     jira_url: str = ""
     jira_project: str = "OPS"
+    jira_user: str = ""
+    jira_token: str = ""
 
     # --- observability ---
     metrics_enabled: bool = True

@@ -1,0 +1,4 @@
+from .dedup import AlertDeduplicator, RateLimiter, TTLSet
+from .normalizer import AlertNormalizer
+
+__all__ = ["AlertNormalizer", "AlertDeduplicator", "RateLimiter", "TTLSet"]

@@ -1,0 +1,258 @@
+"""HTTP API — webhooks, incidents, graph, approvals, health, metrics.
+
+Route parity with the reference FastAPI app (ingestion/main.py:65-425):
+POST /api/v1/webhooks/{alertmanager,grafana}, incident CRUD + listing with
+filters, the incident graph endpoint (depth-limited subgraph), /health,
+/health/ready and /metrics — plus the approvals endpoints the reference
+lacked (its Slack approval flow had no response path, SURVEY.md §3.6
+item 8). Built on the stdlib ThreadingHTTPServer: no FastAPI/uvicorn in
+this image, and the ingestion edge is not the hot path — the TPU scorer is.
+
+Also fixes reference defect 1: the served entrypoint actually exists
+(`python -m kubernetes_aiops_evidence_graph_tpu.serve`).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from ..models import IncidentStatus
+from ..observability import (
+    ALERTS_DEDUPLICATED,
+    ALERTS_RECEIVED,
+    INCIDENTS_CREATED,
+    REGISTRY,
+    WEBHOOK_LATENCY,
+    get_logger,
+)
+from ..storage import DuplicateIncidentError
+
+log = get_logger("api")
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = []  # (method, pattern, handler name)
+
+
+def route(method: str, pattern: str):
+    def deco(fn):
+        _ROUTES.append((method, re.compile(f"^{pattern}$"), fn.__name__))
+        return fn
+    return deco
+
+
+class ApiHandler(BaseHTTPRequestHandler):
+    app: "Any" = None  # set by make_server
+
+    # -- plumbing ---------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # silence default stderr spam
+        pass
+
+    def _json(self, status: int, payload: Any) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, status: int, text: str, content_type="text/plain") -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            return {}
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        self.query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for m, pattern, name in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(parsed.path)
+            if match:
+                try:
+                    getattr(self, name)(**match.groupdict())
+                except Exception as exc:
+                    log.error("handler_error", path=parsed.path, error=str(exc))
+                    self._json(500, {"error": str(exc)})
+                return
+        self._json(404, {"error": f"no route {method} {parsed.path}"})
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PATCH(self):
+        self._dispatch("PATCH")
+
+    # -- health & metrics (main.py:83-112) --------------------------------
+
+    @route("GET", "/health")
+    def health(self):
+        self._json(200, {"status": "healthy", "service": self.app.settings.app_name})
+
+    @route("GET", "/health/ready")
+    def ready(self):
+        ok = self.app.ready()
+        self._json(200 if ok else 503, {"ready": ok})
+
+    @route("GET", "/metrics")
+    def metrics(self):
+        self._text(200, REGISTRY.expose(), "text/plain; version=0.0.4")
+
+    # -- webhooks (main.py:116-254) ---------------------------------------
+
+    @route("POST", "/api/v1/webhooks/alertmanager")
+    def webhook_alertmanager(self):
+        from .normalizer import AlertNormalizer
+        t0 = time.perf_counter()
+        client = self.client_address[0] if self.client_address else "unknown"
+        if not self.app.rate_limiter.check_rate_limit(client):
+            self._json(429, {"error": "rate limit exceeded"})
+            return
+        payload = self._body()
+        alerts = payload.get("alerts", []) or []
+        if not isinstance(alerts, list) or any(not isinstance(a, dict) for a in alerts):
+            self._json(400, {"error": "alerts must be a list of alert objects"})
+            return
+        created, duplicates = [], 0
+        for alert in alerts:
+            ALERTS_RECEIVED.inc(source="alertmanager")
+            if alert.get("status") != "firing":   # main.py:146-147
+                continue
+            spec = AlertNormalizer.normalize_alertmanager(alert)
+            incident_id = self.app.ingest(spec)
+            if incident_id is None:
+                duplicates += 1
+            else:
+                created.append(incident_id)
+        WEBHOOK_LATENCY.observe(time.perf_counter() - t0, endpoint="alertmanager")
+        self._json(200, {"created": created, "duplicates": duplicates})
+
+    @route("POST", "/api/v1/webhooks/grafana")
+    def webhook_grafana(self):
+        from .normalizer import AlertNormalizer
+        t0 = time.perf_counter()
+        payload = self._body()
+        created, duplicates = [], 0
+        for spec in AlertNormalizer.normalize_grafana(payload):
+            ALERTS_RECEIVED.inc(source="grafana")
+            incident_id = self.app.ingest(spec)
+            if incident_id is None:
+                duplicates += 1
+            else:
+                created.append(incident_id)
+        WEBHOOK_LATENCY.observe(time.perf_counter() - t0, endpoint="grafana")
+        self._json(200, {"created": created, "duplicates": duplicates})
+
+    # -- incidents (main.py:256-342) --------------------------------------
+
+    @route("GET", "/api/v1/incidents")
+    def list_incidents(self):
+        rows = self.app.db.list_incidents(
+            status=self.query.get("status"),
+            namespace=self.query.get("namespace"),
+            severity=self.query.get("severity"),
+            limit=int(self.query.get("limit", 100)),
+            offset=int(self.query.get("offset", 0)),
+        )
+        self._json(200, {"incidents": rows, "count": len(rows)})
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)")
+    def get_incident(self, incident_id: str):
+        row = self.app.db.get_incident(incident_id)
+        if row is None:
+            self._json(404, {"error": "incident not found"})
+        else:
+            self._json(200, row)
+
+    @route("PATCH", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)")
+    def patch_incident(self, incident_id: str):
+        body = self._body()
+        status = body.get("status")
+        if status not in {s.value for s in IncidentStatus}:
+            self._json(400, {"error": f"invalid status {status!r}"})
+            return
+        self.app.db.update_incident_status(incident_id, IncidentStatus(status))
+        self._json(200, self.app.db.get_incident(incident_id))
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/graph")
+    def incident_graph(self, incident_id: str):
+        depth = int(self.query.get("depth", 3))  # main.py:303 default depth=3
+        self._json(200, self.app.store.get_incident_subgraph(incident_id, depth=depth))
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/evidence")
+    def incident_evidence(self, incident_id: str):
+        self._json(200, {"evidence": self.app.db.evidence_for(incident_id)})
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/hypotheses")
+    def incident_hypotheses(self, incident_id: str):
+        self._json(200, {"hypotheses": self.app.db.hypotheses_for(incident_id)})
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/runbook")
+    def incident_runbook(self, incident_id: str):
+        rb = self.app.db.runbook_for(incident_id)
+        if rb is None:
+            self._json(404, {"error": "no runbook"})
+        else:
+            self._json(200, rb)
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/actions")
+    def incident_actions(self, incident_id: str):
+        self._json(200, {"actions": self.app.db.actions_for(incident_id)})
+
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/status")
+    def incident_workflow_status(self, incident_id: str):
+        self._json(200, self.app.workflow_status(incident_id))
+
+    # -- approvals (new; closes the reference's approval gap) -------------
+
+    @route("GET", "/api/v1/approvals")
+    def list_approvals(self):
+        from ..integrations import BROKER
+        self._json(200, {"pending": [r.model_dump(mode="json")
+                                     for r in BROKER.pending()]})
+
+    @route("POST", r"/api/v1/approvals/(?P<action_id>[0-9a-f-]+)")
+    def resolve_approval(self, action_id: str):
+        from ..integrations import BROKER
+        body = self._body()
+        ok = BROKER.resolve(
+            action_id,
+            approved=bool(body.get("approved")),
+            responder=body.get("responder", "api"),
+            notes=body.get("notes"),
+        )
+        self._json(200 if ok else 404,
+                   {"resolved": ok, "action_id": action_id})
+
+    # -- traces (observability; new) --------------------------------------
+
+    @route("GET", "/api/v1/traces")
+    def traces(self):
+        from ..observability import TRACER
+        self._json(200, {"spans": TRACER.export(self.query.get("trace_id"))})
+
+
+def make_server(app, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
+    handler = type("BoundApiHandler", (ApiHandler,), {"app": app})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server

@@ -1,0 +1,97 @@
+"""Fingerprint dedup + rate limiting (no Redis: in-process TTL store).
+
+Parity with the reference AlertDeduplicator/RateLimiter
+(deduplicator.py:16-177) with its two defects fixed (SURVEY.md §3.6):
+
+* fingerprints are ACTUALLY REGISTERED on incident creation (the reference
+  defined register_fingerprint but never called it — defect 4), with the
+  same 4h TTL (deduplicator.py:20);
+* duplicate checks fail open like the reference (:69-72), and the Postgres
+  UNIQUE-constraint backstop survives as the storage layer's open-
+  fingerprint index.
+
+The rate limiter keeps the reference's fixed-window INCR+EXPIRE semantics
+(:147-177) at 100 req/min (settings.py:119).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..config import Settings, get_settings
+
+
+class TTLSet:
+    """Monotonic-clock TTL set with lazy expiry."""
+
+    def __init__(self, clock=time.monotonic) -> None:
+        self._clock = clock
+        self._expiry: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, key: str, ttl_s: float) -> None:
+        with self._lock:
+            self._expiry[key] = self._clock() + ttl_s
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            exp = self._expiry.get(key)
+            if exp is None:
+                return False
+            if exp < self._clock():
+                del self._expiry[key]
+                return False
+            return True
+
+    def discard(self, key: str) -> None:
+        with self._lock:
+            self._expiry.pop(key, None)
+
+    def purge(self) -> int:
+        now = self._clock()
+        with self._lock:
+            dead = [k for k, exp in self._expiry.items() if exp < now]
+            for k in dead:
+                del self._expiry[k]
+            return len(dead)
+
+
+class AlertDeduplicator:
+    def __init__(self, settings: Settings | None = None, clock=time.monotonic) -> None:
+        self.settings = settings or get_settings()
+        self._seen = TTLSet(clock)
+
+    def check_duplicate(self, fingerprint: str) -> bool:
+        try:
+            return fingerprint in self._seen
+        except Exception:
+            return False  # fail open (deduplicator.py:69-72)
+
+    def register_fingerprint(self, fingerprint: str) -> None:
+        self._seen.add(fingerprint, self.settings.dedup_ttl_seconds)
+
+    def release(self, fingerprint: str) -> None:
+        """Allow re-alerting once an incident resolves."""
+        self._seen.discard(fingerprint)
+
+
+class RateLimiter:
+    """Fixed one-minute windows per client key (deduplicator.py:147-177)."""
+
+    def __init__(self, settings: Settings | None = None, clock=time.monotonic) -> None:
+        self.settings = settings or get_settings()
+        self._clock = clock
+        self._windows: dict[str, tuple[int, int]] = {}  # key -> (window, count)
+        self._lock = threading.Lock()
+
+    def check_rate_limit(self, client: str) -> bool:
+        """True when the request is allowed."""
+        window = int(self._clock() // 60)
+        limit = self.settings.webhook_rate_limit_per_minute
+        with self._lock:
+            w, count = self._windows.get(client, (window, 0))
+            if w != window:
+                w, count = window, 0
+            count += 1
+            self._windows[client] = (w, count)
+            return count <= limit

@@ -1,0 +1,174 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+Implements the FULL metric set the reference promised but only partially
+emitted (SURVEY.md §3.6 item 7): the 5 real ones (main.py:30-48,
+base.py:19-23) plus the 4 referenced-but-never-defined ones, without a
+prometheus_client dependency.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Iterable
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str = ""):
+        super().__init__(name, help_, "counter")
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] += value
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Gauge(Counter):
+    def __init__(self, name: str, help_: str = ""):
+        _Metric.__init__(self, name, help_, "gauge")
+        self._values = defaultdict(float)
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Histogram(_Metric):
+    def __init__(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def time(self, **labels):
+        import time as _t
+
+        class _Timer:
+            def __enter__(timer):
+                timer.t0 = _t.perf_counter()
+                return timer
+
+            def __exit__(timer, *exc):
+                self.observe(_t.perf_counter() - timer.t0, **labels)
+                return False
+
+        return _Timer()
+
+    def percentile(self, q: float, **labels) -> float:
+        """Approximate percentile from bucket counts (upper bound)."""
+        key = tuple(sorted(labels.items()))
+        total = self._totals.get(key, 0)
+        if not total:
+            return 0.0
+        target = q * total
+        counts = self._counts.get(key, [])
+        for i, c in enumerate(counts):
+            if c >= target:
+                return self.buckets[i]
+        return float("inf")
+
+    def expose(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._totals):
+            counts = self._counts.get(key, [0] * len(self.buckets))
+            for b, c in zip(self.buckets, counts):
+                yield f'{self.name}_bucket{_fmt_labels(key, le=b)} {c}'
+            yield f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {self._totals[key]}'
+            yield f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}"
+            yield f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
+
+
+def _fmt_labels(key: tuple, le=None) -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if le is not None:
+        parts.append(f'le="{le}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            return self._metrics.setdefault(metric.name, metric)
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self.register(Counter(name, help_))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self.register(Gauge(name, help_))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self.register(Histogram(name, help_, buckets))  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# The reference's metric surface, complete (main.py:30-48 + promised set):
+ALERTS_RECEIVED = REGISTRY.counter(
+    "aiops_alerts_received_total", "Alerts received by webhook")
+ALERTS_DEDUPLICATED = REGISTRY.counter(
+    "aiops_alerts_deduplicated_total", "Alerts dropped as duplicates")
+INCIDENTS_CREATED = REGISTRY.counter(
+    "aiops_incidents_created_total", "Incidents created")
+INCIDENTS_RESOLVED = REGISTRY.counter(
+    "aiops_incidents_resolved_total", "Incidents resolved or closed")
+REMEDIATION_ATTEMPTS = REGISTRY.counter(
+    "aiops_remediation_attempts_total", "Remediation executions attempted")
+HYPOTHESES_GENERATED = REGISTRY.counter(
+    "aiops_hypotheses_generated_total", "Hypotheses generated")
+EVIDENCE_COLLECTED = REGISTRY.counter(
+    "aiops_evidence_collected_total", "Evidence items collected")
+WEBHOOK_LATENCY = REGISTRY.histogram(
+    "aiops_webhook_latency_seconds", "Webhook handling latency")
+COLLECTOR_DURATION = REGISTRY.histogram(
+    "aiops_collector_duration_seconds", "Per-collector collection duration")
+RCA_DURATION = REGISTRY.histogram(
+    "aiops_rca_duration_seconds", "RCA scoring duration (new)")
+WORKFLOW_STEP_DURATION = REGISTRY.histogram(
+    "aiops_workflow_step_duration_seconds", "Workflow step duration (new)")

@@ -1,0 +1,103 @@
+"""Span tracing — actually wired, unlike the reference.
+
+The reference declares OTel deps + a Tempo endpoint but contains zero
+opentelemetry imports (SURVEY.md §5). Here: a dependency-free tracer with
+workflow-step and collector spans, in-memory ring buffer + JSON export, and
+an optional jax.profiler bridge for the device-side RCA pass.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    start_s: float
+    end_s: float = 0.0
+    attributes: dict[str, Any] = field(default_factory=dict)
+    status: str = "ok"
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_s - self.start_s) * 1e3
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "start_s": self.start_s, "duration_ms": self.duration_ms,
+            "attributes": self.attributes, "status": self.status,
+        }
+
+
+class Tracer:
+    def __init__(self, max_spans: int = 4096) -> None:
+        self._spans: list[Span] = []
+        self._lock = threading.Lock()
+        self._max = max_spans
+        self._tls = threading.local()
+
+    def _current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Span]:
+        parent = self._current()
+        s = Span(
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            name=name,
+            start_s=time.time(),
+            attributes=attributes,
+        )
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(s)
+        try:
+            yield s
+        except Exception as exc:
+            s.status = f"error:{type(exc).__name__}"
+            raise
+        finally:
+            s.end_s = time.time()
+            stack.pop()
+            with self._lock:
+                self._spans.append(s)
+                if len(self._spans) > self._max:
+                    self._spans = self._spans[-self._max:]
+
+    def export(self, trace_id: str | None = None) -> list[dict]:
+        with self._lock:
+            return [s.to_dict() for s in self._spans
+                    if trace_id is None or s.trace_id == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+TRACER = Tracer()
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """jax.profiler bridge for the TPU scoring path: wraps a block in a
+    profiler trace viewable in TensorBoard/XProf."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,8 @@
+from .executor import RemediationExecutor
+from .orchestrator import ACTION_RISKS, RemediationOrchestrator
+from .verifier import RemediationVerifier
+
+__all__ = [
+    "ACTION_RISKS", "RemediationOrchestrator", "RemediationExecutor",
+    "RemediationVerifier",
+]

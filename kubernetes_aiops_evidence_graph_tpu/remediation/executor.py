@@ -1,0 +1,101 @@
+"""Remediation executor — dispatches approved actions to the cluster.
+
+Parity with the reference RemediationExecutor (executor.py:45-307): the same
+dispatch table (restart_pod → delete the unhealthy-or-first pod, :86-134;
+restart_deployment, :136-175; rollback to previous revision, :177-234;
+scale with default current+1, :236-281; cordon, :283-307) — issued through
+the ClusterAdminBackend interface, plus a dry-run mode and idempotent
+execution the reference lacked.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from ..config import Settings, get_settings
+from ..models import ActionStatus, ActionType, RemediationAction
+from ..utils.timeutils import utcnow
+
+
+class RemediationExecutor:
+    def __init__(self, backend: Any, settings: Settings | None = None) -> None:
+        self.backend = backend
+        self.settings = settings or get_settings()
+        self._executed_keys: set[str] = set()
+        self._dispatch = {
+            ActionType.RESTART_POD: self._restart_pod,
+            ActionType.DELETE_POD: self._restart_pod,
+            ActionType.RESTART_DEPLOYMENT: self._restart_deployment,
+            ActionType.ROLLBACK_DEPLOYMENT: self._rollback_deployment,
+            ActionType.SCALE_REPLICAS: self._scale_replicas,
+            ActionType.CORDON_NODE: self._cordon_node,
+        }
+
+    def execute(self, action: RemediationAction) -> RemediationAction:
+        if action.idempotency_key in self._executed_keys:
+            action.status = ActionStatus.SKIPPED
+            action.status_reason = "duplicate idempotency key"
+            return action
+        handler = self._dispatch.get(action.action_type)
+        if handler is None:
+            action.status = ActionStatus.SKIPPED
+            action.status_reason = f"no executor for {action.action_type.value}"
+            return action
+        action.executed_at = utcnow()
+        action.status = ActionStatus.EXECUTING
+        if self.settings.remediation_dry_run:
+            action.status = ActionStatus.COMPLETED
+            action.completed_at = utcnow()
+            action.execution_result = {"dry_run": True}
+            self._executed_keys.add(action.idempotency_key)
+            return action
+        try:
+            result = handler(action)
+            action.execution_result = result
+            action.status = (ActionStatus.COMPLETED if result.get("ok")
+                             else ActionStatus.FAILED)
+            if not result.get("ok"):
+                action.error_message = result.get("error", "action failed")
+        except Exception as exc:
+            action.status = ActionStatus.FAILED
+            action.error_message = str(exc)
+        action.completed_at = utcnow()
+        self._executed_keys.add(action.idempotency_key)
+        return action
+
+    # -- handlers ---------------------------------------------------------
+
+    def _restart_pod(self, action: RemediationAction) -> dict:
+        ns = action.target_namespace
+        pods = self.backend.list_pods(ns, action.target_resource)
+        if not pods:
+            # target may be a pod name rather than a service
+            ok = self.backend.delete_pod(ns, action.target_resource)
+            return {"ok": ok, "deleted": action.target_resource if ok else None}
+        unhealthy = [p for p in pods if not p.ready or p.waiting_reason
+                     or p.terminated_reason]
+        victim = (unhealthy or pods)[0]  # unhealthy-or-first (:86-134)
+        ok = self.backend.delete_pod(ns, victim.name)
+        return {"ok": ok, "deleted": victim.name}
+
+    def _restart_deployment(self, action: RemediationAction) -> dict:
+        ok = self.backend.restart_deployment(action.target_namespace,
+                                             action.target_resource)
+        return {"ok": ok, "restarted": action.target_resource}
+
+    def _rollback_deployment(self, action: RemediationAction) -> dict:
+        ok = self.backend.rollback_deployment(action.target_namespace,
+                                              action.target_resource)
+        return {"ok": ok, "rolled_back": action.target_resource}
+
+    def _scale_replicas(self, action: RemediationAction) -> dict:
+        ns = action.target_namespace
+        deploys = self.backend.list_deployments(ns, action.target_resource)
+        if not deploys:
+            return {"ok": False, "error": "deployment not found"}
+        target = action.parameters.get("replicas", deploys[0].replicas + 1)  # :236-281
+        ok = self.backend.scale_deployment(ns, deploys[0].name, int(target))
+        return {"ok": ok, "replicas": int(target)}
+
+    def _cordon_node(self, action: RemediationAction) -> dict:
+        ok = self.backend.cordon_node(action.target_resource)
+        return {"ok": ok, "cordoned": action.target_resource}

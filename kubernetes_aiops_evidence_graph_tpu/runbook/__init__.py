@@ -1,0 +1,3 @@
+from .generator import RunbookGenerator
+
+__all__ = ["RunbookGenerator"]

@@ -1,0 +1,5 @@
+"""`python -m kubernetes_aiops_evidence_graph_tpu.serve` — run the platform."""
+from .app import main
+
+if __name__ == "__main__":
+    main()

@@ -240,3 +240,132 @@ class FakeCluster:
 
     def advance(self, seconds: float) -> None:
         self.now = self.now + timedelta(seconds=seconds)
+
+    # -- ClusterAdminBackend: remediation actions -------------------------
+    # These model how real K8s reacts to the corresponding executor verbs;
+    # a restarted/rolled-back pod comes back healthy unless the underlying
+    # fault is environmental, so the verifier sees genuine improvement.
+
+    def _node_healthy(self, name: str) -> bool:
+        node = self.nodes.get(name)
+        if node is None:
+            return True
+        if node.conditions.get("Ready", "True") != "True":
+            return False
+        return not any(
+            node.conditions.get(c) == "True"
+            for c in ("MemoryPressure", "DiskPressure", "PIDPressure",
+                      "NetworkUnavailable"))
+
+    def _heal_pod(self, p: PodState) -> None:
+        """Restart outcome: healthy unless the fault is environmental — a pod
+        rescheduled onto a sick node stays not-ready."""
+        p.waiting_reason = None
+        p.terminated_reason = None
+        p.restart_count = 0
+        p.readiness_probe_failing = False
+        p.started_at = self.now
+        if self._node_healthy(p.node):
+            p.phase = "Running"
+            p.ready = True
+            p.not_ready_seconds = 0.0
+        else:
+            p.phase = "Pending"
+            p.ready = False
+
+    def _recompute_ready(self, namespace: str, deployment: str) -> None:
+        d = self.deployments.get(self._key(namespace, deployment))
+        if d is not None:
+            d.ready_replicas = sum(
+                1 for p in self.list_pods(namespace, d.service)
+                if p.deployment == deployment and p.ready)
+
+    def _heal_service_metrics(self, namespace: str, service: str) -> None:
+        key = self._key(namespace, service)
+        if key in self.metrics:  # reset existing gauges, don't invent new ones
+            self.metrics[key] = ServiceMetrics()
+        for p in self.list_pods(namespace, service):
+            self.pod_logs.pop(self._key(namespace, p.name), None)
+
+    def delete_pod(self, namespace: str, name: str) -> bool:
+        """Delete → controller recreates it (executor.py:86-134 analog)."""
+        key = self._key(namespace, name)
+        p = self.pods.get(key)
+        if p is None:
+            return False
+        self._heal_pod(p)
+        self._recompute_ready(namespace, p.deployment)
+        return True
+
+    def restart_deployment(self, namespace: str, deployment: str) -> bool:
+        key = self._key(namespace, deployment)
+        d = self.deployments.get(key)
+        if d is None:
+            return False
+        for p in self.list_pods(namespace, d.service):
+            if p.deployment == deployment:
+                self._heal_pod(p)
+        self._recompute_ready(namespace, deployment)
+        self._heal_service_metrics(namespace, d.service)
+        return True
+
+    def rollback_deployment(self, namespace: str, deployment: str) -> bool:
+        """Restore previous template (executor.py:177-234 analog)."""
+        key = self._key(namespace, deployment)
+        d = self.deployments.get(key)
+        if d is None or d.prev_image is None:
+            return False
+        d.image, d.prev_image = d.prev_image, d.image
+        d.revision += 1
+        d.changed_at = self.now
+        return self.restart_deployment(namespace, deployment)
+
+    def _schedulable_node(self, preferred: str | None = None) -> str:
+        """Pick a target node honoring cordons (Unschedulable)."""
+        if preferred is not None:
+            node = self.nodes.get(preferred)
+            if node is not None and node.conditions.get("Unschedulable") != "True":
+                return preferred
+        for name in sorted(self.nodes):
+            if self.nodes[name].conditions.get("Unschedulable") != "True":
+                return name
+        return preferred or "node-0"
+
+    def scale_deployment(self, namespace: str, deployment: str, replicas: int) -> bool:
+        key = self._key(namespace, deployment)
+        d = self.deployments.get(key)
+        if d is None:
+            return False
+        pods = [p for p in self.list_pods(namespace, d.service)
+                if p.deployment == deployment]
+        if replicas < len(pods):  # scale down removes pods
+            for p in pods[replicas:]:
+                del self.pods[self._key(namespace, p.name)]
+        else:
+            template = pods[0] if pods else None
+            existing = {p.name for p in pods}
+            i = 0
+            while len(existing) < replicas:
+                name = f"{deployment}-scaled-{i}"
+                i += 1
+                if name in existing:
+                    continue
+                existing.add(name)
+                self.pods[self._key(namespace, name)] = PodState(
+                    name=name, namespace=namespace, deployment=deployment,
+                    service=d.service,
+                    node=self._schedulable_node(template.node if template else None),
+                    started_at=self.now)
+        d.replicas = replicas
+        self.invalidate_index()
+        self._recompute_ready(namespace, deployment)
+        return True
+
+    def cordon_node(self, name: str) -> bool:
+        """Mark unschedulable; surfaced by the kubernetes collector and
+        honored by _schedulable_node for future placements."""
+        node = self.nodes.get(name)
+        if node is None:
+            return False
+        node.conditions["Unschedulable"] = "True"
+        return True

@@ -1,0 +1,3 @@
+from .sqlite import Database, DuplicateIncidentError
+
+__all__ = ["Database", "DuplicateIncidentError"]

@@ -1,0 +1,150 @@
+"""Durable workflow engine — Temporal's job, in-process.
+
+The reference leans on a Temporal server for durability: per-activity
+retries with exponential backoff and non-retryable exception classes
+(incident_workflow.py:60-72), per-step timeouts, event-history replay on
+worker restart, and queryable in-flight state (:40-53). This engine
+reproduces that contract with a SQLite step-journal (storage.sqlite
+workflow_journal table): every step result is recorded, a re-run of the
+same workflow id replays completed steps from the journal instead of
+re-executing them, failed steps retry with backoff, and steps are expected
+to be idempotent (SURVEY.md §5 checkpoint/resume).
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Sequence
+
+from ..observability import WORKFLOW_STEP_DURATION, TRACER, get_logger
+from ..storage import Database
+
+log = get_logger("workflow")
+
+
+class NonRetryableError(Exception):
+    """Fail the step immediately (reference non_retryable_error_types)."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Reference defaults: 3 attempts, 1s → 5m exponential backoff
+    (incident_workflow.py:60-72)."""
+    max_attempts: int = 3
+    initial_interval_s: float = 1.0
+    backoff: float = 2.0
+    max_interval_s: float = 300.0
+    non_retryable: tuple[type[Exception], ...] = (ValueError, TypeError,
+                                                  NonRetryableError)
+
+    def delay(self, attempt: int) -> float:
+        return min(self.initial_interval_s * self.backoff ** (attempt - 1),
+                   self.max_interval_s)
+
+
+@dataclass
+class Step:
+    name: str
+    fn: Callable[..., Any]          # sync or async, takes (ctx) -> JSONable
+    timeout_s: float = 30.0
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    # skip the step (recorded as "skipped") when the predicate is false
+    condition: Callable[[Any], bool] | None = None
+
+
+class StepFailed(Exception):
+    def __init__(self, step: str, cause: Exception, attempts: int):
+        super().__init__(f"step {step} failed after {attempts} attempts: {cause}")
+        self.step = step
+        self.cause = cause
+        self.attempts = attempts
+
+
+class WorkflowEngine:
+    """Executes a linear Step pipeline with journal-backed replay."""
+
+    def __init__(self, db: Database, sleeper=asyncio.sleep) -> None:
+        self.db = db
+        self._sleep = sleeper  # injectable for tests
+
+    async def run(self, workflow_id: str, steps: Sequence[Step], ctx: Any) -> dict:
+        """Run (or resume) a workflow. Returns {step: result}. Completed
+        steps in the journal are replayed, not re-executed."""
+        journal = self.db.journal_get(workflow_id)
+        results: dict[str, Any] = {}
+        for entry_name, entry in journal.items():
+            if entry["status"] in ("completed", "skipped"):
+                results[entry_name] = entry["result"]
+        if hasattr(ctx, "results"):
+            ctx.results.update(results)
+
+        for step in steps:
+            if step.name in results:
+                log.debug("step_replayed", workflow=workflow_id, step=step.name)
+                continue
+            if step.condition is not None and not step.condition(ctx):
+                self.db.journal_put(workflow_id, step.name, "skipped", None)
+                results[step.name] = None
+                if hasattr(ctx, "results"):
+                    ctx.results[step.name] = None
+                continue
+            result = await self._run_step(workflow_id, step, ctx)
+            results[step.name] = result
+            if hasattr(ctx, "results"):
+                ctx.results[step.name] = result
+        return results
+
+    async def _run_step(self, workflow_id: str, step: Step, ctx: Any) -> Any:
+        attempts = 0
+        while True:
+            attempts += 1
+            self.db.journal_put(workflow_id, step.name, "running",
+                                attempts=attempts)
+            t0 = time.perf_counter()
+            try:
+                with TRACER.span(f"workflow.{step.name}", workflow=workflow_id):
+                    if inspect.iscoroutinefunction(step.fn):
+                        result = await asyncio.wait_for(
+                            step.fn(ctx), timeout=step.timeout_s)
+                    else:
+                        result = await asyncio.wait_for(
+                            asyncio.get_event_loop().run_in_executor(
+                                None, step.fn, ctx),
+                            timeout=step.timeout_s)
+                json.dumps(result, default=str)  # journal-serializable check
+                WORKFLOW_STEP_DURATION.observe(
+                    time.perf_counter() - t0, step=step.name)
+                self.db.journal_put(workflow_id, step.name, "completed",
+                                    result, attempts=attempts)
+                return result
+            except Exception as exc:
+                WORKFLOW_STEP_DURATION.observe(
+                    time.perf_counter() - t0, step=step.name)
+                retryable = not isinstance(exc, step.retry.non_retryable)
+                log.warning("step_failed", workflow=workflow_id, step=step.name,
+                            attempt=attempts, error=str(exc), retryable=retryable)
+                if not retryable or attempts >= step.retry.max_attempts:
+                    self.db.journal_put(workflow_id, step.name, "failed",
+                                        {"error": str(exc)}, attempts=attempts)
+                    raise StepFailed(step.name, exc, attempts) from exc
+                await self._sleep(step.retry.delay(attempts))
+
+    def status(self, workflow_id: str) -> dict:
+        """Queryable in-flight state (reference @workflow.query, :40-53)."""
+        journal = self.db.journal_get(workflow_id)
+        done = [s for s, e in journal.items() if e["status"] == "completed"]
+        failed = [s for s, e in journal.items() if e["status"] == "failed"]
+        running = [s for s, e in journal.items() if e["status"] == "running"]
+        return {
+            "workflow_id": workflow_id,
+            "steps": journal,
+            "completed": done,
+            "failed": failed,
+            "running": running,
+            "state": ("failed" if failed else
+                      "running" if running else
+                      "completed" if done else "pending"),
+        }

@@ -1,0 +1,343 @@
+"""The 12-step incident workflow.
+
+Step-for-step parity with the reference IncidentWorkflow
+(incident_workflow.py:19-31 docstring, :55-292 body) and its activities
+(activities.py:25-363), with the reference's timeout budget:
+
+ 1 collect_evidence   5m   collectors (actually parallel) + persist
+ 2 build_graph        2m   batch ingest into the in-memory store
+ 3 generate_hypotheses 3m  rca_backend plugin (cpu|tpu) + optional LLM
+ 4 rank_hypotheses    30s  (constant-folded; recorded for parity)
+ 5 generate_runbook   30s
+ 6 calculate_blast_radius 30s
+ 7 evaluate_policy    30s  proposes the top hypothesis' MACHINE action —
+                           never prose (fixes SURVEY.md §3.6 item 6)
+ 8 request_approval   4h   dev auto-approve; else ApprovalBroker (real
+                           response path, unlike the reference's stub)
+ 9 execute_remediation 5m
+10 verify_remediation 2m wait + 2m verify
+11 create_ticket      30s  iff not allowed or verification failed
+12 close_incident     30s  resolved/closed by verification outcome
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..collectors import collect_all, default_collectors
+from ..config import Settings, get_settings
+from ..graph import GraphBuilder, build_snapshot
+from ..integrations import JiraClient, SlackClient
+from ..models import (
+    ActionStatus,
+    ApprovalRequest,
+    Hypothesis,
+    HypothesisCategory,
+    HypothesisSource,
+    Incident,
+    IncidentStatus,
+    RemediationAction,
+)
+from ..observability import (
+    HYPOTHESES_GENERATED,
+    INCIDENTS_RESOLVED,
+    RCA_DURATION,
+    REMEDIATION_ATTEMPTS,
+    get_logger,
+)
+from ..rca import get_backend
+from ..rca.llm import LLMSummarizer
+from ..remediation import RemediationExecutor, RemediationOrchestrator, RemediationVerifier
+from ..runbook import RunbookGenerator
+from ..storage import Database
+from ..utils.timeutils import utcnow
+from .engine import Step, WorkflowEngine
+
+log = get_logger("incident_workflow")
+
+
+@dataclass
+class IncidentContext:
+    """Everything a workflow run needs; results accumulate per step."""
+    incident: Incident
+    cluster: Any                       # ClusterBackend (+ admin surface)
+    db: Database
+    builder: GraphBuilder
+    settings: Settings = field(default_factory=get_settings)
+    results: dict[str, Any] = field(default_factory=dict)
+    # transient (not journal-serialized)
+    evidence_dicts: list[dict] = field(default_factory=list)
+    hypotheses: list[Hypothesis] = field(default_factory=list)
+    action: RemediationAction | None = None
+    baseline: dict = field(default_factory=dict)
+    slack: SlackClient | None = None
+    jira: JiraClient | None = None
+
+
+# -- step implementations (activities.py analogs) --------------------------
+
+def collect_evidence(ctx: IncidentContext) -> dict:
+    collectors = default_collectors(ctx.cluster, ctx.settings)
+    results = collect_all(ctx.incident, collectors, parallel=True)
+    all_ev = [e for r in results for e in r.evidence]
+    ctx.db.insert_evidence(all_ev)  # one batch, not per-row (activities.py:61-84)
+    ctx.evidence_dicts = [e.model_dump(mode="json") for e in all_ev]
+    ctx.results["_collector_results"] = results  # for build_graph (in-memory)
+    return {
+        "evidence_count": len(all_ev),
+        "collectors": {r.collector_name: r.success for r in results},
+        "errors": [err for r in results for err in r.errors],
+    }
+
+
+def build_graph(ctx: IncidentContext) -> dict:
+    results = ctx.results.pop("_collector_results", None)
+    if results is None:  # replayed run: rebuild from persisted evidence
+        from ..models import CollectorResult, Evidence
+        evs = [Evidence(**{**row, "data": row["data"]})
+               for row in _evidence_rows(ctx)]
+        results = [CollectorResult(collector_name="replay", evidence=evs)]
+    stats = ctx.builder.ingest(ctx.incident, results)
+    return {k: v for k, v in stats.items() if k != "incident_node"}
+
+
+def _evidence_rows(ctx: IncidentContext) -> list[dict]:
+    rows = ctx.db.evidence_for(ctx.incident.id)
+    for r in rows:
+        r.setdefault("incident_id", str(ctx.incident.id))
+    return rows
+
+
+def generate_hypotheses(ctx: IncidentContext) -> dict:
+    import time as _t
+    t0 = _t.perf_counter()
+    backend_name = ctx.settings.rca_backend
+    if backend_name == "tpu":
+        snapshot = build_snapshot(ctx.builder.store, ctx.settings)
+        tpu = get_backend("tpu")
+        all_results = tpu.results(snapshot)
+        mine = [r for r in all_results
+                if str(r.incident_id) == str(ctx.incident.id)]
+        hyps = mine[0].hypotheses if mine else []
+    else:
+        hyps = get_backend("cpu").score_incident(
+            ctx.incident.id, ctx.evidence_dicts or _evidence_rows(ctx)).hypotheses
+    llm = LLMSummarizer(ctx.settings)
+    if llm.enabled:
+        hyps = llm.enhance_hypotheses(ctx.incident, hyps, ctx.evidence_dicts)
+    ctx.hypotheses = hyps
+    RCA_DURATION.observe(_t.perf_counter() - t0, backend=backend_name)
+    HYPOTHESES_GENERATED.inc(len(hyps))
+    ctx.db.insert_hypotheses(hyps)
+    return {
+        "count": len(hyps),
+        "backend": backend_name,
+        "top_rule": hyps[0].rule_id if hyps else None,
+        "top_confidence": hyps[0].confidence if hyps else None,
+    }
+
+
+def rank_hypotheses(ctx: IncidentContext) -> dict:
+    # ranking is constant-folded into generation (ruleset.py); recorded for
+    # lifecycle parity with activities.py:164-173
+    return {"ranked": [h.rule_id for h in ctx.hypotheses],
+            "top_score": ctx.hypotheses[0].final_score if ctx.hypotheses else None}
+
+
+def generate_runbook(ctx: IncidentContext) -> dict:
+    if not ctx.hypotheses:
+        return {"generated": False}
+    rb = RunbookGenerator().generate(ctx.incident, ctx.hypotheses[0])
+    ctx.db.insert_runbook(rb)
+    return {"generated": True, "title": rb.title, "steps": len(rb.steps)}
+
+
+def calculate_blast_radius(ctx: IncidentContext) -> dict:
+    orch = RemediationOrchestrator(ctx.cluster, ctx.settings)
+    blast = orch.calculate_blast_radius(ctx.incident)
+    return blast.model_dump(mode="json")
+
+
+def evaluate_policy(ctx: IncidentContext) -> dict:
+    """Propose the top hypothesis' machine action (activities.py:207-246 —
+    but using the structured ``action`` field, not recommended_actions[0]
+    prose)."""
+    top = ctx.hypotheses[0] if ctx.hypotheses else None
+    machine_action = _machine_action(top)
+    if machine_action is None:
+        return {"proposed": False, "reason": "no machine-executable action"}
+    orch = RemediationOrchestrator(ctx.cluster, ctx.settings)
+    target = (ctx.incident.service or ctx.incident.namespace)
+    if machine_action == "cordon_node":
+        pods = ctx.cluster.list_pods(ctx.incident.namespace, ctx.incident.service)
+        target = pods[0].node if pods else target
+    action = orch.propose_action(ctx.incident, machine_action, target)
+    action.hypothesis_id = top.id if top else None
+    ctx.action = action
+    ctx.db.upsert_action(action)
+    return {
+        "proposed": True,
+        "action_type": action.action_type.value,
+        "target": action.target_resource,
+        "allowed": action.status == ActionStatus.PROPOSED,
+        "requires_approval": action.requires_approval,
+        "reason": action.status_reason,
+    }
+
+
+def _machine_action(top: Hypothesis | None) -> str | None:
+    if top is None:
+        return None
+    from ..rca import RULE_INDEX, RULES
+    if top.rule_id in RULE_INDEX:
+        rule = RULES[RULE_INDEX[top.rule_id]]
+        return rule.action.value if rule.action else None
+    return None
+
+
+def request_approval(ctx: IncidentContext) -> dict:
+    action = ctx.action
+    assert action is not None
+    if not action.requires_approval:
+        action.status = ActionStatus.APPROVED
+        action.approved_by = "auto-dev"  # activities.py:251-252
+        ctx.db.upsert_action(action)
+        return {"approved": True, "by": "auto-dev"}
+    slack = ctx.slack or SlackClient(ctx.settings)
+    req = ApprovalRequest(
+        action_id=action.id, incident_id=ctx.incident.id,
+        incident_title=ctx.incident.title, action_type=action.action_type,
+        target_resource=action.target_resource,
+        target_namespace=action.target_namespace,
+        risk_level=action.risk_level,
+        blast_radius_score=action.blast_radius_score,
+        hypothesis_summary=ctx.hypotheses[0].description if ctx.hypotheses else "",
+    )
+    timeout = ctx.settings.approval_timeout_seconds
+    resp = slack.request_approval(req, timeout_s=timeout)
+    approved = bool(resp and resp.approved)
+    action.status = ActionStatus.APPROVED if approved else ActionStatus.REJECTED
+    if approved:
+        action.approved_by = resp.responder
+    else:
+        action.rejection_reason = "timeout" if resp is None else (resp.notes or "rejected")
+    ctx.db.upsert_action(action)
+    return {"approved": approved,
+            "by": resp.responder if resp else None,
+            "timed_out": resp is None}
+
+
+def execute_remediation(ctx: IncidentContext) -> dict:
+    action = ctx.action
+    assert action is not None
+    verifier = RemediationVerifier(ctx.cluster)
+    ctx.baseline = verifier.capture_baseline(ctx.incident)
+    REMEDIATION_ATTEMPTS.inc(action_type=action.action_type.value)
+    executed = RemediationExecutor(ctx.cluster, ctx.settings).execute(action)
+    ctx.db.upsert_action(executed)
+    return {"status": executed.status.value,
+            "result": executed.execution_result,
+            "error": executed.error_message}
+
+
+async def verify_remediation(ctx: IncidentContext) -> dict:
+    await asyncio.sleep(min(ctx.settings.verification_wait_seconds, 120))
+    verifier = RemediationVerifier(ctx.cluster)
+    result = verifier.verify(ctx.incident, ctx.action, ctx.baseline)
+    ctx.db.insert_verification(result)
+    return {"success": result.success,
+            "metrics_improved": result.metrics_improved,
+            "pods_healthy_after": result.pods_healthy_after}
+
+
+def create_ticket(ctx: IncidentContext) -> dict:
+    jira = ctx.jira or JiraClient(ctx.settings)
+    top = ctx.hypotheses[0] if ctx.hypotheses else None
+    return jira.create_incident_ticket(ctx.incident, top)
+
+
+def close_incident(ctx: IncidentContext) -> dict:
+    verified = (ctx.results.get("verify_remediation") or {}).get("success")
+    status = IncidentStatus.RESOLVED if verified else IncidentStatus.CLOSED
+    ctx.db.update_incident_status(ctx.incident.id, status, resolved_at=utcnow())
+    INCIDENTS_RESOLVED.inc(status=status.value)
+    return {"status": status.value}
+
+
+# -- pipeline assembly ------------------------------------------------------
+
+def _action_allowed(ctx: IncidentContext) -> bool:
+    return bool(ctx.action is not None
+                and ctx.action.status != ActionStatus.REJECTED
+                and (ctx.results.get("evaluate_policy") or {}).get("allowed"))
+
+
+def _approved(ctx: IncidentContext) -> bool:
+    return (_action_allowed(ctx)
+            and bool((ctx.results.get("request_approval") or {}).get("approved")))
+
+
+def _needs_ticket(ctx: IncidentContext) -> bool:
+    policy = ctx.results.get("evaluate_policy") or {}
+    verify = ctx.results.get("verify_remediation") or {}
+    return (not policy.get("allowed", False)
+            or not (ctx.results.get("request_approval") or {}).get("approved", False)
+            or verify.get("success") is False)  # incident_workflow.py:246-250
+
+
+def incident_steps(settings: Settings | None = None) -> list[Step]:
+    s = settings or get_settings()
+    remediation_on = s.remediation_enabled
+    return [
+        Step("collect_evidence", collect_evidence, timeout_s=300),
+        Step("build_graph", build_graph, timeout_s=120),
+        Step("generate_hypotheses", generate_hypotheses, timeout_s=180),
+        Step("rank_hypotheses", rank_hypotheses, timeout_s=30),
+        Step("generate_runbook", generate_runbook, timeout_s=30),
+        Step("calculate_blast_radius", calculate_blast_radius, timeout_s=30),
+        Step("evaluate_policy", evaluate_policy, timeout_s=30,
+             condition=lambda ctx: remediation_on),
+        Step("request_approval", request_approval,
+             timeout_s=s.approval_timeout_seconds + 5,
+             condition=_action_allowed),
+        Step("execute_remediation", execute_remediation, timeout_s=300,
+             condition=_approved),
+        Step("verify_remediation", verify_remediation,
+             timeout_s=s.verification_wait_seconds + 120,
+             condition=lambda ctx: (ctx.results.get("execute_remediation") or {}
+                                    ).get("status") == "completed"),
+        Step("create_ticket", create_ticket, timeout_s=30,
+             condition=_needs_ticket),
+        Step("close_incident", close_incident, timeout_s=30),
+    ]
+
+
+async def run_incident_workflow(
+    incident: Incident,
+    cluster: Any,
+    db: Database,
+    builder: GraphBuilder | None = None,
+    settings: Settings | None = None,
+    engine: WorkflowEngine | None = None,
+    slack: SlackClient | None = None,
+    jira: JiraClient | None = None,
+) -> dict:
+    """Entry point: the reference's `start_workflow("IncidentWorkflow",
+    id=f"incident-{id}")` (main.py:406-413)."""
+    s = settings or get_settings()
+    ctx = IncidentContext(
+        incident=incident, cluster=cluster, db=db,
+        builder=builder or GraphBuilder(), settings=s,
+        slack=slack, jira=jira,
+    )
+    engine = engine or WorkflowEngine(db)
+    db.update_incident_status(incident.id, IncidentStatus.INVESTIGATING)
+    try:
+        results = await engine.run(f"incident-{incident.id}",
+                                   incident_steps(s), ctx)
+    except Exception as exc:
+        log.error("workflow_failed", incident=str(incident.id), error=str(exc))
+        db.audit(str(incident.id), "workflow_failed", {"error": str(exc)})
+        raise
+    return results

@@ -1,0 +1,83 @@
+"""Incident worker — the Temporal worker analog.
+
+The reference worker registers the workflow + activities on task queue
+"incident-workflow" and scales horizontally (worker.py:31-73). Here: an
+asyncio queue with N concurrent workflow slots in one process; horizontal
+scale-out is running more processes against the same SQLite/cluster
+backends (journal idempotency makes replays safe).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from ..config import Settings, get_settings
+from ..graph import GraphBuilder
+from ..models import Incident
+from ..observability import get_logger
+from ..storage import Database
+from .engine import WorkflowEngine
+from .incident_workflow import run_incident_workflow
+
+log = get_logger("worker")
+
+
+class IncidentWorker:
+    def __init__(
+        self,
+        cluster: Any,
+        db: Database,
+        builder: GraphBuilder | None = None,
+        settings: Settings | None = None,
+        concurrency: int = 4,
+    ) -> None:
+        self.cluster = cluster
+        self.db = db
+        self.builder = builder or GraphBuilder()
+        self.settings = settings or get_settings()
+        self.concurrency = concurrency
+        self.queue: asyncio.Queue[Incident | None] = asyncio.Queue()
+        self.engine = WorkflowEngine(db)
+        self._tasks: list[asyncio.Task] = []
+        self.completed: int = 0
+        self.failed: int = 0
+
+    async def submit(self, incident: Incident) -> None:
+        await self.queue.put(incident)
+
+    async def _worker_loop(self, slot: int) -> None:
+        while True:
+            incident = await self.queue.get()
+            if incident is None:
+                self.queue.task_done()
+                return
+            try:
+                await run_incident_workflow(
+                    incident, self.cluster, self.db, builder=self.builder,
+                    settings=self.settings, engine=self.engine)
+                self.completed += 1
+            except Exception as exc:
+                self.failed += 1
+                log.error("incident_workflow_error", slot=slot,
+                          incident=str(incident.id), error=str(exc))
+            finally:
+                self.queue.task_done()
+
+    async def start(self) -> None:
+        self._tasks = [asyncio.create_task(self._worker_loop(i))
+                       for i in range(self.concurrency)]
+
+    async def drain(self) -> None:
+        """Wait for queue to empty, then stop workers."""
+        await self.queue.join()
+        for _ in self._tasks:
+            await self.queue.put(None)
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+    async def run_all(self, incidents: list[Incident]) -> dict:
+        await self.start()
+        for inc in incidents:
+            await self.submit(inc)
+        await self.drain()
+        return {"completed": self.completed, "failed": self.failed}

@@ -1,0 +1,80 @@
+"""Policy engine semantics vs the reference Rego policy
+(remediation.rego:1-167) — each rule family gets a direct check."""
+from kubernetes_aiops_evidence_graph_tpu.policy import (
+    PolicyEngine, PolicyInput, evaluate,
+)
+
+
+def _p(**kw):
+    base = dict(action_type="restart_pod", environment="dev",
+                blast_radius_score=10.0, namespace="default",
+                affected_replicas=1, current_hour=12, is_weekend=False)
+    base.update(kw)
+    return PolicyInput(**base)
+
+
+def test_default_deny_unknown_action():
+    assert not evaluate(_p(action_type="delete_namespace")).allow
+
+
+def test_env_allowlists():
+    assert evaluate(_p(action_type="cordon_node", environment="dev")).allow
+    assert not evaluate(_p(action_type="cordon_node", environment="staging")).allow
+    assert not evaluate(_p(action_type="rollback_deployment", environment="prod")).allow
+    assert evaluate(_p(action_type="rollback_deployment", environment="staging")).allow
+
+
+def test_high_risk_never_allowed():
+    for action in ("drain_node", "update_configmap", "uncordon_node"):
+        r = evaluate(_p(action_type=action, environment="dev"))
+        assert not r.allow
+
+
+def test_freeze_windows():
+    # late night blocks staging/prod but not dev (rego :9-24)
+    assert not evaluate(_p(environment="prod", current_hour=23)).allow
+    assert not evaluate(_p(environment="staging", current_hour=3)).allow
+    assert evaluate(_p(environment="dev", current_hour=23)).allow
+    # prod weekend freeze
+    assert not evaluate(_p(environment="prod", is_weekend=True)).allow
+    assert evaluate(_p(environment="staging", is_weekend=True)).allow
+    # explicit freeze flag
+    assert not evaluate(_p(environment="prod", freeze_active=True)).allow
+
+
+def test_blast_radius_thresholds():
+    assert not evaluate(_p(environment="prod", blast_radius_score=60)).allow
+    assert evaluate(_p(environment="staging", blast_radius_score=60)).allow
+    assert not evaluate(_p(environment="staging", blast_radius_score=80)).allow
+    assert evaluate(_p(environment="dev", blast_radius_score=99)).allow
+    # replica cap only binds outside dev/staging carve-outs
+    assert not evaluate(_p(environment="prod", affected_replicas=6)).allow
+
+
+def test_protected_namespaces():
+    assert not evaluate(_p(environment="prod", namespace="kube-system")).allow
+    assert evaluate(_p(environment="dev", namespace="kube-system")).allow
+    r = evaluate(_p(environment="prod", namespace="monitoring"))
+    assert "protected" in (r.reason or "")
+
+
+def test_requires_approval_rules():
+    assert evaluate(_p(environment="prod")).requires_approval
+    assert evaluate(_p(environment="staging", blast_radius_score=35)).requires_approval
+    assert not evaluate(_p(environment="staging", blast_radius_score=10)).requires_approval
+    assert evaluate(_p(action_type="rollback_deployment")).requires_approval
+    assert evaluate(_p(action_type="cordon_node")).requires_approval
+    assert evaluate(_p(affected_replicas=3)).requires_approval
+    assert not evaluate(_p(environment="dev")).requires_approval
+
+
+def test_facade_env_normalization():
+    engine = PolicyEngine()
+    from datetime import datetime, timezone
+    weekday_noon = datetime(2026, 7, 29, 12, 0, tzinfo=timezone.utc)
+    out = engine.evaluate_remediation(
+        "restart_pod", "development", 10.0, "default", now=weekday_noon)
+    assert out["allow"] is True and out["requires_approval"] is False
+    out = engine.evaluate_remediation(
+        "restart_pod", "production", 10.0, "default", now=weekday_noon)
+    assert out["requires_approval"] is True

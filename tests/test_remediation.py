@@ -1,0 +1,104 @@
+"""Remediation loop against the fake cluster: blast radius math, policy
+gating in the orchestrator, executor healing faults, verifier confirming."""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.models import ActionStatus, ActionType
+from kubernetes_aiops_evidence_graph_tpu.remediation import (
+    RemediationExecutor, RemediationOrchestrator, RemediationVerifier,
+)
+from kubernetes_aiops_evidence_graph_tpu.runbook import RunbookGenerator
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+DEV = load_settings(app_env="development", remediation_dry_run=False)
+PROD = load_settings(app_env="production", remediation_dry_run=False)
+
+
+def _broken_cluster(scenario="crashloop_deploy", seed=5):
+    cluster = generate_cluster(num_pods=60, seed=seed)
+    target = sorted(cluster.deployments)[0]
+    incident = inject(cluster, scenario, target, np.random.default_rng(seed))
+    return cluster, target, incident
+
+
+def test_blast_radius_formula():
+    cluster, target, incident = _broken_cluster()
+    orch = RemediationOrchestrator(cluster, PROD)
+    blast = orch.calculate_blast_radius(incident)
+    replicas = cluster.deployments[target].replicas
+    expected = min((replicas * 5 + 10) * (1.5 if incident.namespace == "default" else 1.0) * 5.0, 100.0)
+    assert blast.final_score == round(expected, 2)
+    assert blast.affected_deployments == 1
+    # dev multiplier is 1.0
+    blast_dev = RemediationOrchestrator(cluster, DEV).calculate_blast_radius(incident)
+    assert blast_dev.final_score < blast.final_score
+
+
+def test_propose_action_policy_gating():
+    cluster, target, incident = _broken_cluster()
+    dev_action = RemediationOrchestrator(cluster, DEV).propose_action(
+        incident, "rollback_deployment", incident.service)
+    assert dev_action.status == ActionStatus.PROPOSED
+    assert dev_action.requires_approval is False  # dev auto-approve (:156-157)
+
+    prod_action = RemediationOrchestrator(cluster, PROD).propose_action(
+        incident, "rollback_deployment", incident.service)
+    assert prod_action.status == ActionStatus.REJECTED  # not in prod allowlist
+    assert prod_action.requires_approval is True
+
+    unknown = RemediationOrchestrator(cluster, DEV).propose_action(
+        incident, "no_such_action", incident.service)
+    assert unknown.action_type == ActionType.ESCALATE_TO_HUMAN
+
+
+def test_execute_rollback_heals_and_verifier_confirms():
+    cluster, target, incident = _broken_cluster("crashloop_deploy")
+    orch = RemediationOrchestrator(cluster, DEV)
+    verifier = RemediationVerifier(cluster)
+    baseline = verifier.capture_baseline(incident)
+    assert baseline["healthy_pods"] < baseline["total_pods"]
+
+    action = orch.propose_action(incident, "rollback_deployment", incident.service)
+    executed = RemediationExecutor(cluster, DEV).execute(action)
+    assert executed.status == ActionStatus.COMPLETED, executed.error_message
+    assert executed.execution_result["ok"]
+
+    result = verifier.verify(incident, executed, baseline)
+    assert result.success and result.metrics_improved
+    assert result.pods_healthy_after == baseline["total_pods"]
+    # the image actually rolled back
+    assert cluster.deployments[target].image.endswith(":v1")
+
+
+def test_executor_idempotency_and_dry_run():
+    cluster, target, incident = _broken_cluster("oom")
+    orch = RemediationOrchestrator(cluster, DEV)
+    action = orch.propose_action(incident, "restart_deployment", incident.service)
+
+    dry = RemediationExecutor(cluster, load_settings(app_env="development",
+                                                     remediation_dry_run=True))
+    out = dry.execute(action)
+    assert out.status == ActionStatus.COMPLETED and out.execution_result == {"dry_run": True}
+    # pods still broken after dry run
+    assert any(p.terminated_reason for p in cluster.list_pods(incident.namespace, incident.service))
+
+    real = RemediationExecutor(cluster, DEV)
+    action2 = orch.propose_action(incident, "restart_deployment", incident.service)
+    real.execute(action2)
+    repeat = real.execute(action2)
+    assert repeat.status == ActionStatus.SKIPPED  # idempotency key replay
+
+
+def test_runbook_generation():
+    from kubernetes_aiops_evidence_graph_tpu.rca import get_backend
+    cluster, target, incident = _broken_cluster("crashloop_deploy")
+    from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
+    results = collect_all(incident, default_collectors(cluster, DEV), parallel=False)
+    evidence = [e.model_dump(mode="json") for r in results for e in r.evidence]
+    top = get_backend("cpu").score_incident(incident.id, evidence).top_hypothesis
+
+    rb = RunbookGenerator().generate(incident, top)
+    assert "rollout undo" in " ".join(rb.kubectl_commands)
+    assert incident.service in rb.kubectl_commands[0]
+    assert len(rb.steps) >= 3
+    assert rb.metadata["rule_id"] == "crashloop_recent_deploy"

@@ -1,0 +1,80 @@
+from uuid import uuid4
+
+import pytest
+
+import kubernetes_aiops_evidence_graph_tpu.models as m
+from kubernetes_aiops_evidence_graph_tpu.storage import Database, DuplicateIncidentError
+
+
+def _incident(fp="fp-1", status=m.IncidentStatus.OPEN):
+    return m.Incident(fingerprint=fp, title="t", severity=m.Severity.HIGH,
+                      source=m.IncidentSource.ALERTMANAGER, status=status)
+
+
+def test_incident_crud_and_dedup_constraint():
+    db = Database(":memory:")
+    inc = _incident()
+    db.create_incident(inc)
+    assert db.get_incident(inc.id)["fingerprint"] == "fp-1"
+
+    # open duplicate rejected (init-db.sql:27 analog)
+    with pytest.raises(DuplicateIncidentError) as err:
+        db.create_incident(_incident())
+    assert err.value.existing_id == str(inc.id)
+
+    # resolving frees the fingerprint
+    db.update_incident_status(inc.id, m.IncidentStatus.RESOLVED)
+    db.create_incident(_incident())
+    assert len(db.list_incidents()) == 2
+    assert db.list_incidents(status="resolved")[0]["id"] == str(inc.id)
+    db.close()
+
+
+def test_evidence_hypotheses_roundtrip():
+    db = Database(":memory:")
+    inc = _incident()
+    db.create_incident(inc)
+    ev = m.Evidence(incident_id=inc.id, evidence_type=m.EvidenceType.KUBERNETES_POD,
+                    source=m.EvidenceSource.KUBERNETES_API, entity_name="p",
+                    data={"waiting_reason": "CrashLoopBackOff"})
+    assert db.insert_evidence([ev]) == 1
+    rows = db.evidence_for(inc.id)
+    assert rows[0]["data"]["waiting_reason"] == "CrashLoopBackOff"
+
+    hyp = m.Hypothesis(incident_id=inc.id, category=m.HypothesisCategory.BAD_DEPLOYMENT,
+                       title="h", confidence=0.9, rank=1, rule_id="crashloop_recent_deploy")
+    db.insert_hypotheses([hyp])
+    assert db.hypotheses_for(inc.id)[0]["rule_id"] == "crashloop_recent_deploy"
+    # re-insert replaces rather than duplicates
+    db.insert_hypotheses([hyp])
+    assert len(db.hypotheses_for(inc.id)) == 1
+    db.close()
+
+
+def test_journal_and_audit():
+    db = Database(":memory:")
+    db.journal_put("wf-1", "collect", "completed", {"n": 3}, attempts=1)
+    db.journal_put("wf-1", "rca", "running", attempts=2)
+    j = db.journal_get("wf-1")
+    assert j["collect"]["result"] == {"n": 3}
+    assert j["rca"]["attempts"] == 2
+    db.journal_put("wf-1", "rca", "completed", {"ok": True}, attempts=2)
+    assert db.journal_get("wf-1")["rca"]["status"] == "completed"
+
+    db.audit("inc-9", "custom_event", {"x": 1})
+    assert any(a["event"] == "custom_event" for a in db.audit_for("inc-9"))
+    db.close()
+
+
+def test_action_upsert_idempotency():
+    db = Database(":memory:")
+    inc = _incident()
+    db.create_incident(inc)
+    a = m.RemediationAction(incident_id=inc.id, idempotency_key="k1",
+                            action_type=m.ActionType.RESTART_POD, target_resource="svc")
+    db.upsert_action(a)
+    a.status = m.ActionStatus.COMPLETED
+    db.upsert_action(a)  # same idempotency key → update, not duplicate
+    rows = db.actions_for(inc.id)
+    assert len(rows) == 1 and rows[0]["status"] == "completed"
+    db.close()

@@ -1,0 +1,160 @@
+"""Workflow engine + 12-step incident lifecycle tests: retries, replay,
+conditions, and the full end-to-end pipeline healing a fault."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+from kubernetes_aiops_evidence_graph_tpu.storage import Database
+from kubernetes_aiops_evidence_graph_tpu.workflow import (
+    IncidentWorker, Step, StepFailed, WorkflowEngine, run_incident_workflow,
+)
+
+DEV = load_settings(
+    app_env="development", remediation_dry_run=False,
+    verification_wait_seconds=0, rca_backend="cpu",
+    node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+    incident_bucket_sizes=(8, 32),
+)
+
+
+class Ctx:
+    def __init__(self):
+        self.results = {}
+        self.calls = []
+
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_engine_retry_and_non_retryable():
+    db = Database(":memory:")
+    sleeps = []
+
+    async def fake_sleep(s):
+        sleeps.append(s)
+
+    engine = WorkflowEngine(db, sleeper=fake_sleep)
+    ctx = Ctx()
+    attempts = {"n": 0}
+
+    def flaky(c):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise RuntimeError("transient")
+        return {"ok": True}
+
+    out = _run(engine.run("wf-retry", [Step("flaky", flaky, timeout_s=5)], ctx))
+    assert out["flaky"] == {"ok": True} and attempts["n"] == 3
+    assert sleeps == [1.0, 2.0]  # exponential backoff
+
+    def bad(c):
+        raise ValueError("no retry")
+
+    with pytest.raises(StepFailed) as err:
+        _run(engine.run("wf-nr", [Step("bad", bad)], ctx))
+    assert err.value.attempts == 1  # ValueError is non-retryable
+    db.close()
+
+
+def test_engine_replay_skips_completed_steps():
+    db = Database(":memory:")
+    engine = WorkflowEngine(db)
+    ctx = Ctx()
+    runs = {"a": 0, "b": 0}
+
+    def step_a(c):
+        runs["a"] += 1
+        return {"v": 1}
+
+    def step_b_fail(c):
+        runs["b"] += 1
+        raise ValueError("boom")
+
+    steps = [Step("a", step_a), Step("b", step_b_fail)]
+    with pytest.raises(StepFailed):
+        _run(engine.run("wf-replay", steps, ctx))
+    assert runs == {"a": 1, "b": 1}
+
+    # resume: a replays from journal, b re-executes and now succeeds
+    def step_b_ok(c):
+        runs["b"] += 1
+        return {"v": 2}
+
+    ctx2 = Ctx()
+    out = _run(engine.run("wf-replay", [Step("a", step_a), Step("b", step_b_ok)], ctx2))
+    assert runs["a"] == 1  # NOT re-executed
+    assert out == {"a": {"v": 1}, "b": {"v": 2}}
+    assert engine.status("wf-replay")["state"] == "completed"
+    db.close()
+
+
+def _world(scenario="crashloop_deploy", seed=9):
+    cluster = generate_cluster(num_pods=60, seed=seed)
+    target = sorted(cluster.deployments)[0]
+    incident = inject(cluster, scenario, target, np.random.default_rng(seed))
+    db = Database(":memory:")
+    from kubernetes_aiops_evidence_graph_tpu.models import Incident
+    db.create_incident(incident)
+    return cluster, target, incident, db
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_full_incident_lifecycle_heals_fault(backend):
+    cluster, target, incident, db = _world()
+    settings = load_settings(**{**DEV.__dict__, "rca_backend": backend})
+    results = _run(run_incident_workflow(incident, cluster, db, settings=settings))
+
+    assert results["generate_hypotheses"]["top_rule"] == "crashloop_recent_deploy"
+    assert results["evaluate_policy"]["allowed"] is True
+    assert results["request_approval"]["approved"] is True  # dev auto-approve
+    assert results["execute_remediation"]["status"] == "completed"
+    assert results["verify_remediation"]["success"] is True
+    assert results["close_incident"]["status"] == "resolved"
+    # ticket only on failure/deny — not here
+    assert results["create_ticket"] is None
+    # cluster actually healed
+    assert all(p.ready for p in cluster.list_pods(incident.namespace, incident.service))
+    # durable state written
+    assert db.get_incident(incident.id)["status"] == "resolved"
+    assert db.hypotheses_for(incident.id)[0]["rule_id"] == "crashloop_recent_deploy"
+    assert db.runbook_for(incident.id) is not None
+    assert len(db.actions_for(incident.id)) == 1
+    db.close()
+
+
+def test_lifecycle_denied_action_creates_ticket():
+    cluster, target, incident, db = _world("imagepull")
+    # image_pull_failure has no machine action -> no proposal -> ticket path
+    results = _run(run_incident_workflow(incident, cluster, db, settings=DEV))
+    assert results["evaluate_policy"]["proposed"] is False
+    assert results["execute_remediation"] is None  # condition-skipped
+    ticket = results["create_ticket"]
+    assert ticket["queued"] is True  # jira unconfigured -> offline queue
+    assert results["close_incident"]["status"] == "closed"
+    db.close()
+
+
+def test_worker_processes_concurrent_incidents():
+    cluster = generate_cluster(num_pods=120, seed=4)
+    keys = sorted(cluster.deployments)
+    rng = np.random.default_rng(4)
+    scenarios = ["crashloop_deploy", "oom", "network", "hpa_maxed"]
+    incidents = [inject(cluster, s, keys[i * 3], rng) for i, s in enumerate(scenarios)]
+    db = Database(":memory:")
+    for inc in incidents:
+        db.create_incident(inc)
+
+    async def go():
+        worker = IncidentWorker(cluster, db, settings=DEV, concurrency=3)
+        return await worker.run_all(incidents)
+
+    stats = _run(go())
+    assert stats == {"completed": 4, "failed": 0}
+    statuses = {db.get_incident(i.id)["status"] for i in incidents}
+    assert statuses <= {"resolved", "closed"}
+    db.close()
