@@ -146,6 +146,82 @@ def bench_labelprop(num_nodes: int, iters: int):
     return statistics.median(times)
 
 
+def ensure_responsive_device(probe_timeout_s: int = 120) -> str:
+    """The axon TPU tunnel can wedge (jax.devices() then blocks forever).
+    Probe device init in a subprocess; on timeout/failure, fall back to the
+    CPU platform so the bench always completes and prints its JSON line."""
+    import subprocess
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); import jax.numpy as jnp;"
+             "(jnp.ones((8,8))@jnp.ones((8,8))).block_until_ready();"
+             "print(d[0].platform)"],
+            capture_output=True, timeout=probe_timeout_s, text=True)
+        platform = (proc.stdout or "").strip().splitlines()[-1] if proc.stdout else ""
+        if proc.returncode == 0 and platform:
+            print(f"bench: device platform = {platform}", file=sys.stderr)
+            return platform
+    except subprocess.TimeoutExpired:
+        pass
+    print("bench: device probe failed/hung — falling back to CPU platform",
+          file=sys.stderr)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def bench_streaming(num_pods: int, num_incidents: int, events: int,
+                    batch_size: int = 100, seed: int = 0, verbose=True):
+    """BASELINE configs[4]: churn applied in ticks of `batch_size` events,
+    each tick followed by an incremental re-score. Reports sustained
+    events/sec including scoring."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import StreamingScorer
+    from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject, SCENARIOS
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        apply_event, churn_events, sync_touched_to_store,
+    )
+    from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose else (lambda *a: None)
+    settings = load_settings()
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    names = sorted(SCENARIOS)
+    for i in range(num_incidents):
+        inc = inject(cluster, names[i % len(names)], keys[(i * 7) % len(keys)], rng)
+        builder.ingest(inc, collect_all(inc, default_collectors(cluster, settings),
+                                        parallel=False))
+    scorer = StreamingScorer(builder.store, settings)
+    scorer.rescore()  # warm compile
+
+    stream = list(churn_events(cluster, events, seed=seed + 1))
+    t0 = time.perf_counter()
+    rescore_times = []
+    for tick_start in range(0, len(stream), batch_size):
+        for ev in stream[tick_start:tick_start + batch_size]:
+            touched = apply_event(cluster, ev)
+            sync_touched_to_store(cluster, builder.store, touched)
+            if ev.kind == "reschedule" and touched:
+                scorer.reschedule_pod(touched[0], f"node:{ev.payload['node']}")
+            scorer.update_nodes(touched)
+        t1 = time.perf_counter()
+        scorer.rescore()
+        rescore_times.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    eps = len(stream) / wall
+    log(f"streaming: {len(stream)} events in {wall:.2f}s = {eps:.0f} events/s "
+        f"(ticks of {batch_size}; rescore p50 "
+        f"{statistics.median(rescore_times)*1e3:.2f} ms)")
+    return eps, statistics.median(rescore_times)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small shapes, CPU-safe")
@@ -154,6 +230,17 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--cpu-sample", type=int, default=50)
     args = ap.parse_args(argv)
+    ensure_responsive_device()
+
+    if args.config == 4 and not args.smoke:
+        eps, rescore_p50 = bench_streaming(10_000, 100, events=2000)
+        print(json.dumps({
+            "metric": "streaming_churn_events_per_sec_incl_rescoring",
+            "value": round(eps, 1),
+            "unit": "events/s (target 1000)",
+            "vs_baseline": round(eps / 1000.0, 3),
+        }))
+        return 0
 
     if args.config == 2 and not args.smoke:
         # BASELINE configs[2]: 10k-node batched anomaly label propagation

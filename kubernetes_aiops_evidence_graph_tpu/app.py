@@ -43,7 +43,7 @@ class AiopsApp:
         self.dedup = AlertDeduplicator(self.settings)
         self.rate_limiter = RateLimiter(self.settings)
         self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
-                                     settings=self.settings)
+                                     settings=self.settings, dedup=self.dedup)
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: threading.Thread | None = None
         self._server = None
@@ -74,8 +74,11 @@ class AiopsApp:
             self._server.shutdown()
             self._server = None
         if self._loop is not None:
-            asyncio.run_coroutine_threadsafe(
-                self.worker.drain(), self._loop).result(timeout=30)
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.worker.drain(), self._loop).result(timeout=30)
+            except Exception as exc:  # drain stuck (e.g. pending approval)
+                log.warning("drain_timeout_forcing_stop", error=str(exc))
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=5)
             self._loop = None

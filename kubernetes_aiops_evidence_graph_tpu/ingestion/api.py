@@ -150,6 +150,10 @@ class ApiHandler(BaseHTTPRequestHandler):
     def webhook_grafana(self):
         from .normalizer import AlertNormalizer
         t0 = time.perf_counter()
+        client = self.client_address[0] if self.client_address else "unknown"
+        if not self.app.rate_limiter.check_rate_limit(client):
+            self._json(429, {"error": "rate limit exceeded"})
+            return
         payload = self._body()
         created, duplicates = [], 0
         for spec in AlertNormalizer.normalize_grafana(payload):
@@ -166,12 +170,18 @@ class ApiHandler(BaseHTTPRequestHandler):
 
     @route("GET", "/api/v1/incidents")
     def list_incidents(self):
+        try:
+            limit = int(self.query.get("limit", 100))
+            offset = int(self.query.get("offset", 0))
+        except ValueError:
+            self._json(400, {"error": "limit/offset must be integers"})
+            return
         rows = self.app.db.list_incidents(
             status=self.query.get("status"),
             namespace=self.query.get("namespace"),
             severity=self.query.get("severity"),
-            limit=int(self.query.get("limit", 100)),
-            offset=int(self.query.get("offset", 0)),
+            limit=limit,
+            offset=offset,
         )
         self._json(200, {"incidents": rows, "count": len(rows)})
 
@@ -190,7 +200,10 @@ class ApiHandler(BaseHTTPRequestHandler):
         if status not in {s.value for s in IncidentStatus}:
             self._json(400, {"error": f"invalid status {status!r}"})
             return
-        self.app.db.update_incident_status(incident_id, IncidentStatus(status))
+        from ..utils.timeutils import utcnow
+        resolved_at = (utcnow() if status in ("resolved", "closed") else None)
+        self.app.db.update_incident_status(
+            incident_id, IncidentStatus(status), resolved_at=resolved_at)
         self._json(200, self.app.db.get_incident(incident_id))
 
     @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/graph")

@@ -38,7 +38,9 @@ class Counter(_Metric):
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -54,7 +56,9 @@ class Gauge(Counter):
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -106,13 +110,18 @@ class Histogram(_Metric):
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for key in sorted(self._totals):
-            counts = self._counts.get(key, [0] * len(self.buckets))
+        with self._lock:
+            snapshot = [
+                (key, list(self._counts.get(key, [0] * len(self.buckets))),
+                 self._sums[key], self._totals[key])
+                for key in sorted(self._totals)
+            ]
+        for key, counts, total_sum, total in snapshot:
             for b, c in zip(self.buckets, counts):
                 yield f'{self.name}_bucket{_fmt_labels(key, le=b)} {c}'
-            yield f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {self._totals[key]}'
-            yield f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}"
-            yield f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}"
+            yield f'{self.name}_bucket{_fmt_labels(key, le="+Inf")} {total}'
+            yield f"{self.name}_sum{_fmt_labels(key)} {total_sum}"
+            yield f"{self.name}_count{_fmt_labels(key)} {total}"
 
 
 def _fmt_labels(key: tuple, le=None) -> str:

@@ -1,0 +1,140 @@
+"""Churn event stream — deterministic cluster mutations at rate.
+
+BASELINE configs[4]: "streaming graph updates (pod churn @1k events/sec)
+with incremental TPU re-scoring". This generator emits a seeded, replayable
+sequence of cluster events (pod restarts, reschedules, status flips, metric
+drifts, rollouts) that the streaming scorer applies as feature/graph deltas
+without rebuilding the snapshot.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator
+
+import numpy as np
+
+from .cluster import FakeCluster
+
+
+@dataclass(frozen=True)
+class ChurnEvent:
+    kind: str                  # pod_restart|pod_flip|reschedule|metric_drift|rollout
+    namespace: str
+    name: str                  # pod or deployment name
+    payload: dict = field(default_factory=dict)
+
+
+_KINDS = ("pod_restart", "pod_flip", "reschedule", "metric_drift", "rollout")
+_WEIGHTS = (0.45, 0.25, 0.1, 0.15, 0.05)
+
+
+def churn_events(
+    cluster: FakeCluster,
+    count: int,
+    seed: int = 0,
+) -> Iterator[ChurnEvent]:
+    """Yield `count` deterministic events referencing real cluster objects."""
+    rng = np.random.default_rng(seed)
+    pod_keys = sorted(cluster.pods)
+    deploy_keys = sorted(cluster.deployments)
+    node_names = sorted(cluster.nodes)
+    if not pod_keys or not deploy_keys:
+        return
+    kinds = rng.choice(len(_KINDS), size=count, p=_WEIGHTS)
+    for i in range(count):
+        kind = _KINDS[kinds[i]]
+        if kind in ("pod_restart", "pod_flip", "reschedule"):
+            key = pod_keys[int(rng.integers(0, len(pod_keys)))]
+            pod = cluster.pods[key]
+            payload: dict = {}
+            if kind == "pod_restart":
+                payload = {"restart_delta": int(rng.integers(1, 3))}
+            elif kind == "pod_flip":
+                payload = {"ready": bool(rng.random() < 0.5)}
+            else:
+                payload = {"node": node_names[int(rng.integers(0, len(node_names)))]}
+            yield ChurnEvent(kind, pod.namespace, pod.name, payload)
+        elif kind == "metric_drift":
+            key = deploy_keys[int(rng.integers(0, len(deploy_keys)))]
+            d = cluster.deployments[key]
+            yield ChurnEvent(kind, d.namespace, d.service, {
+                "memory_pct": float(np.clip(rng.normal(60, 20), 5, 99)),
+                "error_rate": float(np.clip(rng.exponential(0.01), 0, 0.5)),
+            })
+        else:  # rollout
+            key = deploy_keys[int(rng.integers(0, len(deploy_keys)))]
+            d = cluster.deployments[key]
+            yield ChurnEvent(kind, d.namespace, d.name, {})
+
+
+def apply_event(cluster: FakeCluster, event: ChurnEvent) -> list[str]:
+    """Mutate cluster state; returns the graph node ids whose features
+    changed (the delta set for incremental re-scoring)."""
+    touched: list[str] = []
+    key = f"{event.namespace}/{event.name}"
+    if event.kind == "pod_restart":
+        p = cluster.pods.get(key)
+        if p is not None:
+            p.restart_count += event.payload.get("restart_delta", 1)
+            touched.append(f"pod:{p.namespace}:{p.name}")
+    elif event.kind == "pod_flip":
+        p = cluster.pods.get(key)
+        if p is not None:
+            p.ready = event.payload["ready"]
+            p.not_ready_seconds = 0.0 if p.ready else 360.0
+            touched.append(f"pod:{p.namespace}:{p.name}")
+    elif event.kind == "reschedule":
+        p = cluster.pods.get(key)
+        if p is not None:
+            p.node = event.payload["node"]
+            touched.append(f"pod:{p.namespace}:{p.name}")
+    elif event.kind == "metric_drift":
+        m = cluster.service_metrics(event.namespace, event.name)
+        m.memory_pct = event.payload["memory_pct"]
+        m.error_rate = event.payload["error_rate"]
+        touched.append(f"service:{event.namespace}:{event.name}")
+    elif event.kind == "rollout":
+        d = cluster.deployments.get(key)
+        if d is not None:
+            d.revision += 1
+            d.prev_image = d.image
+            d.image = d.image.rsplit(":", 1)[0] + f":v{d.revision}"
+            d.changed_at = cluster.now
+            touched.append(f"deployment:{d.namespace}:{d.name}")
+    return touched
+
+
+def sync_touched_to_store(cluster: FakeCluster, store, touched: list[str]) -> None:
+    """Propagate mutated cluster state onto the graph-store node property
+    bags so feature re-extraction sees the new values (the kube-state sync
+    delta path; full sync is graph.topology_sync)."""
+    for nid in touched:
+        kind, rest = nid.split(":", 1)
+        node = store.get_node(nid)
+        if node is None:
+            continue
+        if kind == "pod":
+            ns, name = rest.split(":", 1)
+            p = cluster.pods.get(f"{ns}/{name}")
+            if p is not None:
+                node_obj = store._nodes[nid]  # in-place property update
+                node_obj.properties.update(
+                    waiting_reason=p.waiting_reason,
+                    terminated_reason=p.terminated_reason,
+                    restart_count=p.restart_count, ready=p.ready,
+                    not_ready_seconds=p.not_ready_seconds, phase=p.phase)
+        elif kind == "service":
+            ns, name = rest.split(":", 1)
+            m = cluster.metrics.get(f"{ns}/{name}")
+            if m is not None:
+                store._nodes[nid].properties.update(
+                    memory_usage_high=m.memory_pct > 90,
+                    latency_high=m.p99_latency_s > 1.0)
+        elif kind == "deployment":
+            ns, name = rest.split(":", 1)
+            d = cluster.deployments.get(f"{ns}/{name}")
+            if d is not None:
+                store._nodes[nid].properties.update(
+                    revision=d.revision,
+                    is_recent_change=True,
+                    changed_at=d.changed_at.isoformat() if d.changed_at else None)

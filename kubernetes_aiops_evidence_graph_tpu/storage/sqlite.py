@@ -236,8 +236,9 @@ class Database:
             row = self.query(
                 "SELECT id FROM incidents WHERE fingerprint=? AND status NOT IN"
                 " ('resolved','closed') LIMIT 1", (incident.fingerprint,))
-            raise DuplicateIncidentError(
-                incident.fingerprint, row[0]["id"] if row else "?")
+            if not row:  # some other constraint failed — not a dedup hit
+                raise
+            raise DuplicateIncidentError(incident.fingerprint, row[0]["id"])
         self.audit(str(incident.id), "incident_created",
                    {"severity": incident.severity.value})
         return incident

@@ -66,13 +66,58 @@ class IncidentContext:
     builder: GraphBuilder
     settings: Settings = field(default_factory=get_settings)
     results: dict[str, Any] = field(default_factory=dict)
-    # transient (not journal-serialized)
+    # transient (not journal-serialized; rehydrated from DB on replay)
     evidence_dicts: list[dict] = field(default_factory=list)
     hypotheses: list[Hypothesis] = field(default_factory=list)
     action: RemediationAction | None = None
     baseline: dict = field(default_factory=dict)
     slack: SlackClient | None = None
     jira: JiraClient | None = None
+    dedup: Any = None  # AlertDeduplicator; fingerprint released on close
+
+
+def _ensure_hypotheses(ctx: IncidentContext) -> list[Hypothesis]:
+    """Rehydrate hypotheses from storage after a journal replay skipped
+    generate_hypotheses (resume-after-crash durability)."""
+    if ctx.hypotheses:
+        return ctx.hypotheses
+    rows = ctx.db.hypotheses_for(ctx.incident.id)
+    ctx.hypotheses = [
+        Hypothesis(
+            id=r["id"], incident_id=r["incident_id"],
+            category=HypothesisCategory(r["category"]), title=r["title"],
+            description=r["description"] or "", confidence=r["confidence"],
+            rank=r["rank"], final_score=r["final_score"], rule_id=r["rule_id"],
+            backend=r["backend"],
+            recommended_actions=r["recommended_actions"],
+            generated_by=HypothesisSource(r["generated_by"]),
+        ) for r in rows
+    ]
+    return ctx.hypotheses
+
+
+def _ensure_action(ctx: IncidentContext) -> RemediationAction | None:
+    """Rehydrate the proposed action from storage after replay."""
+    if ctx.action is not None:
+        return ctx.action
+    rows = ctx.db.actions_for(ctx.incident.id)
+    if not rows:
+        return None
+    r = rows[-1]
+    ctx.action = RemediationAction(
+        id=r["id"], incident_id=r["incident_id"],
+        hypothesis_id=r["hypothesis_id"],
+        idempotency_key=r["idempotency_key"],
+        action_type=r["action_type"], target_resource=r["target_resource"],
+        target_namespace=r["target_namespace"],
+        risk_level=r["risk_level"],
+        blast_radius_score=r["blast_radius_score"],
+        environment=r["environment"], status=ActionStatus(r["status"]),
+        status_reason=r["status_reason"],
+        requires_approval=bool(r["requires_approval"]),
+        approved_by=r["approved_by"],
+    )
+    return ctx.action
 
 
 # -- step implementations (activities.py analogs) --------------------------
@@ -146,7 +191,7 @@ def rank_hypotheses(ctx: IncidentContext) -> dict:
 
 
 def generate_runbook(ctx: IncidentContext) -> dict:
-    if not ctx.hypotheses:
+    if not _ensure_hypotheses(ctx):
         return {"generated": False}
     rb = RunbookGenerator().generate(ctx.incident, ctx.hypotheses[0])
     ctx.db.insert_runbook(rb)
@@ -163,7 +208,8 @@ def evaluate_policy(ctx: IncidentContext) -> dict:
     """Propose the top hypothesis' machine action (activities.py:207-246 —
     but using the structured ``action`` field, not recommended_actions[0]
     prose)."""
-    top = ctx.hypotheses[0] if ctx.hypotheses else None
+    hyps = _ensure_hypotheses(ctx)
+    top = hyps[0] if hyps else None
     machine_action = _machine_action(top)
     if machine_action is None:
         return {"proposed": False, "reason": "no machine-executable action"}
@@ -197,7 +243,7 @@ def _machine_action(top: Hypothesis | None) -> str | None:
 
 
 def request_approval(ctx: IncidentContext) -> dict:
-    action = ctx.action
+    action = _ensure_action(ctx)
     assert action is not None
     if not action.requires_approval:
         action.status = ActionStatus.APPROVED
@@ -229,7 +275,7 @@ def request_approval(ctx: IncidentContext) -> dict:
 
 
 def execute_remediation(ctx: IncidentContext) -> dict:
-    action = ctx.action
+    action = _ensure_action(ctx)
     assert action is not None
     verifier = RemediationVerifier(ctx.cluster)
     ctx.baseline = verifier.capture_baseline(ctx.incident)
@@ -238,13 +284,16 @@ def execute_remediation(ctx: IncidentContext) -> dict:
     ctx.db.upsert_action(executed)
     return {"status": executed.status.value,
             "result": executed.execution_result,
-            "error": executed.error_message}
+            "error": executed.error_message,
+            "baseline": ctx.baseline}  # journaled: survives resume
 
 
 async def verify_remediation(ctx: IncidentContext) -> dict:
     await asyncio.sleep(min(ctx.settings.verification_wait_seconds, 120))
     verifier = RemediationVerifier(ctx.cluster)
-    result = verifier.verify(ctx.incident, ctx.action, ctx.baseline)
+    baseline = ctx.baseline or (
+        ctx.results.get("execute_remediation") or {}).get("baseline") or {}
+    result = verifier.verify(ctx.incident, _ensure_action(ctx), baseline)
     ctx.db.insert_verification(result)
     return {"success": result.success,
             "metrics_improved": result.metrics_improved,
@@ -253,8 +302,8 @@ async def verify_remediation(ctx: IncidentContext) -> dict:
 
 def create_ticket(ctx: IncidentContext) -> dict:
     jira = ctx.jira or JiraClient(ctx.settings)
-    top = ctx.hypotheses[0] if ctx.hypotheses else None
-    return jira.create_incident_ticket(ctx.incident, top)
+    hyps = _ensure_hypotheses(ctx)
+    return jira.create_incident_ticket(ctx.incident, hyps[0] if hyps else None)
 
 
 def close_incident(ctx: IncidentContext) -> dict:
@@ -262,15 +311,17 @@ def close_incident(ctx: IncidentContext) -> dict:
     status = IncidentStatus.RESOLVED if verified else IncidentStatus.CLOSED
     ctx.db.update_incident_status(ctx.incident.id, status, resolved_at=utcnow())
     INCIDENTS_RESOLVED.inc(status=status.value)
+    if ctx.dedup is not None:  # allow re-alerting for recurring faults
+        ctx.dedup.release(ctx.incident.fingerprint)
     return {"status": status.value}
 
 
 # -- pipeline assembly ------------------------------------------------------
 
 def _action_allowed(ctx: IncidentContext) -> bool:
-    return bool(ctx.action is not None
-                and ctx.action.status != ActionStatus.REJECTED
-                and (ctx.results.get("evaluate_policy") or {}).get("allowed"))
+    # journal-derived so it survives replay (ctx.action rehydrates lazily)
+    policy = ctx.results.get("evaluate_policy") or {}
+    return bool(policy.get("proposed") and policy.get("allowed"))
 
 
 def _approved(ctx: IncidentContext) -> bool:
@@ -322,6 +373,7 @@ async def run_incident_workflow(
     engine: WorkflowEngine | None = None,
     slack: SlackClient | None = None,
     jira: JiraClient | None = None,
+    dedup: Any = None,
 ) -> dict:
     """Entry point: the reference's `start_workflow("IncidentWorkflow",
     id=f"incident-{id}")` (main.py:406-413)."""
@@ -329,7 +381,7 @@ async def run_incident_workflow(
     ctx = IncidentContext(
         incident=incident, cluster=cluster, db=db,
         builder=builder or GraphBuilder(), settings=s,
-        slack=slack, jira=jira,
+        slack=slack, jira=jira, dedup=dedup,
     )
     engine = engine or WorkflowEngine(db)
     db.update_incident_status(incident.id, IncidentStatus.INVESTIGATING)

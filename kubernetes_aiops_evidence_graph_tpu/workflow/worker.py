@@ -30,11 +30,13 @@ class IncidentWorker:
         builder: GraphBuilder | None = None,
         settings: Settings | None = None,
         concurrency: int = 4,
+        dedup: Any = None,
     ) -> None:
         self.cluster = cluster
         self.db = db
         self.builder = builder or GraphBuilder()
         self.settings = settings or get_settings()
+        self.dedup = dedup
         self.concurrency = concurrency
         self.queue: asyncio.Queue[Incident | None] = asyncio.Queue()
         self.engine = WorkflowEngine(db)
@@ -54,7 +56,8 @@ class IncidentWorker:
             try:
                 await run_incident_workflow(
                     incident, self.cluster, self.db, builder=self.builder,
-                    settings=self.settings, engine=self.engine)
+                    settings=self.settings, engine=self.engine,
+                    dedup=self.dedup)
                 self.completed += 1
             except Exception as exc:
                 self.failed += 1

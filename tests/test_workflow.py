@@ -139,6 +139,47 @@ def test_lifecycle_denied_action_creates_ticket():
     db.close()
 
 
+def test_resume_after_crash_still_remediates():
+    """Crash right after approval; the resumed run must rehydrate the
+    action from storage and execute remediation (not skip it)."""
+    from kubernetes_aiops_evidence_graph_tpu.workflow import incident_steps
+    from kubernetes_aiops_evidence_graph_tpu.workflow.incident_workflow import IncidentContext
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+
+    cluster, target, incident, db = _world()
+    steps = incident_steps(DEV)
+    crash_at = next(i for i, s in enumerate(steps) if s.name == "execute_remediation")
+
+    # first run executes only up to approval, then "crashes"
+    ctx1 = IncidentContext(incident=incident, cluster=cluster, db=db,
+                           builder=GraphBuilder(), settings=DEV)
+    engine = WorkflowEngine(db)
+    _run(engine.run(f"incident-{incident.id}", steps[:crash_at], ctx1))
+    assert db.actions_for(incident.id)[0]["status"] == "approved"
+    assert any(not p.ready for p in cluster.list_pods(incident.namespace,
+                                                      incident.service))
+
+    # resume with a FRESH context (transient state lost, as after a crash)
+    results = _run(run_incident_workflow(incident, cluster, db, settings=DEV,
+                                         engine=engine))
+    assert results["execute_remediation"]["status"] == "completed"
+    assert results["verify_remediation"]["success"] is True
+    assert all(p.ready for p in cluster.list_pods(incident.namespace,
+                                                  incident.service))
+    db.close()
+
+
+def test_resolved_incident_releases_fingerprint():
+    from kubernetes_aiops_evidence_graph_tpu.ingestion import AlertDeduplicator
+    cluster, target, incident, db = _world()
+    dedup = AlertDeduplicator(DEV)
+    dedup.register_fingerprint(incident.fingerprint)
+    assert dedup.check_duplicate(incident.fingerprint)
+    _run(run_incident_workflow(incident, cluster, db, settings=DEV, dedup=dedup))
+    assert not dedup.check_duplicate(incident.fingerprint)  # released on close
+    db.close()
+
+
 def test_worker_processes_concurrent_incidents():
     cluster = generate_cluster(num_pods=120, seed=4)
     keys = sorted(cluster.deployments)
