@@ -44,20 +44,34 @@ class LogsCollector(BaseCollector):
     name = "logs"
     source = EvidenceSource.LOKI
 
-    def collect(self, incident: Incident) -> CollectorResult:
-        result = CollectorResult(collector_name=self.name)
-        if not incident.service:
-            return result
-        lines = self.backend.query_logs(
-            incident.namespace, incident.service, limit=self.settings.max_log_lines)
-        if not lines:
-            return result
-
+    def _scan(self, lines: list[str]):
+        """Pattern scan: native single-pass scanner when built
+        (native/kaeg_native.cpp scan_logs), else the Python regex loop.
+        Both produce identical (patterns_found order, error_count,
+        network_error_count, samples) — enforced by tests/test_native.py."""
+        from .. import native as _native
+        native_out = _native.scan_logs_native(lines) if _native.available() else None
         patterns_found: list[str] = []
         error_count = 0
         network_error_count = 0
         samples: list[str] = []
-        traces: list[str] = []
+        if native_out is not None:
+            _counts, flags = native_out
+            cats = [c for c, _a, _b in _native.LOG_CATEGORIES]
+            net_mask = sum(1 << i for i, c in enumerate(cats)
+                           if c in _NETWORK_CATEGORIES)
+            for i, line in enumerate(lines):
+                bits = int(flags[i])
+                if not bits:
+                    continue
+                for ci, cat in enumerate(cats):
+                    if bits >> ci & 1 and cat not in patterns_found:
+                        patterns_found.append(cat)
+                error_count += 1
+                network_error_count += (bits & net_mask).bit_count()
+                if len(samples) < 10:
+                    samples.append(line[:500])
+            return patterns_found, error_count, network_error_count, samples
         for line in lines:
             matched_any = False
             for category, rx in ERROR_PATTERNS.items():
@@ -71,6 +85,21 @@ class LogsCollector(BaseCollector):
                 error_count += 1
                 if len(samples) < 10:  # :205-219
                     samples.append(line[:500])
+        return patterns_found, error_count, network_error_count, samples
+
+    def collect(self, incident: Incident) -> CollectorResult:
+        result = CollectorResult(collector_name=self.name)
+        if not incident.service:
+            return result
+        lines = self.backend.query_logs(
+            incident.namespace, incident.service, limit=self.settings.max_log_lines)
+        if not lines:
+            return result
+
+        patterns_found, error_count, network_error_count, samples = (
+            self._scan(lines))
+        traces: list[str] = []
+        for line in lines:
             for trx in STACK_TRACE_PATTERNS:
                 if trx.match(line) and len(traces) < 5:
                     traces.append(line[:500])

@@ -1,0 +1,118 @@
+"""ctypes bindings for the native runtime kernels (native/kaeg_native.cpp).
+
+The library builds lazily on first use (g++ -O3 -shared, cached next to the
+source); every entry point has a pure-Python fallback so the package works
+without a toolchain. `available()` reports whether the native path is live.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parent.parent / "native" / "kaeg_native.cpp"
+_SO = _SRC.with_suffix(".so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if (not _SO.exists()
+                    or _SO.stat().st_mtime < _SRC.stat().st_mtime):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     str(_SRC), "-o", str(_SO)],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(str(_SO))
+            lib.scan_logs.restype = ctypes.c_int64
+            lib.scan_logs.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+                ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64,
+            ]
+            lib.khop_reach.restype = None
+            lib.khop_reach.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            _lib = lib
+        except Exception:
+            _failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# category table mirroring collectors/logs.py ERROR_PATTERNS as
+# boundary-aware substring alternatives; order matters (indices align).
+# boundaries flag mirrors the \b anchors of each regex exactly
+LOG_CATEGORIES = (
+    ("error", "error|err", True),
+    ("critical", "critical|fatal|panic", True),
+    ("oom", "out of memory|oom kill|oom-kill|oomkill", False),
+    ("network", "network unreachable|no route to host|dial tcp", True),
+    ("auth", "unauthorized|forbidden|permission denied|auth", True),
+    ("missing", "not found|no such file|missing", True),
+    ("null_pointer", "nil pointer|null pointer|NoneType", False),
+    ("connection", "connection refused|connection reset|connection closed", False),
+    ("disk", "no space left|disk full|i/o error", True),
+    ("tls", "tls|x509|certificate", True),
+    ("timeout", "timed out|time out|timeout|timedout", True),
+)
+_CAT_BLOB = "\n".join(alts for _, alts, _b in LOG_CATEGORIES).encode()
+_BOUND_MASK = sum((1 << i) for i, (_, _, b) in enumerate(LOG_CATEGORIES) if b)
+
+
+def scan_logs_native(lines: list[str], max_lines: int = 100000):
+    """Returns (counts per category, per-line category bitmasks aligned with
+    `lines`) or None if the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not lines:
+        return ({name: 0 for name, _a, _b in LOG_CATEGORIES},
+                np.zeros(0, dtype=np.uint64))
+    # embedded newlines would desync line indexing — flatten them
+    n_lines = min(len(lines), max_lines)
+    buf = "\n".join(l.replace("\n", " ") for l in lines[:n_lines]
+                    ).encode("utf-8", "replace")
+    counts = (ctypes.c_int64 * len(LOG_CATEGORIES))()
+    flags = (ctypes.c_uint64 * n_lines)()
+    n = lib.scan_logs(buf, len(buf), _CAT_BLOB, len(LOG_CATEGORIES),
+                      _BOUND_MASK, counts, flags, n_lines)
+    return (
+        {LOG_CATEGORIES[i][0]: int(counts[i]) for i in range(len(LOG_CATEGORIES))},
+        np.frombuffer(bytes(flags), dtype=np.uint64, count=int(n)),
+    )
+
+
+def khop_reach_native(edge_src: np.ndarray, edge_dst: np.ndarray,
+                      num_nodes: int, seed: int, hops: int):
+    """BFS reach mask uint8 [num_nodes], or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(edge_src, dtype=np.int32)
+    dst = np.ascontiguousarray(edge_dst, dtype=np.int32)
+    reach = np.zeros(num_nodes, dtype=np.uint8)
+    lib.khop_reach(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        len(src), num_nodes, seed, hops,
+        reach.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return reach

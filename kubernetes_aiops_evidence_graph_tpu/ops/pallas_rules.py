@@ -1,0 +1,179 @@
+"""The deterministic rules engine as ONE fused Pallas TPU kernel.
+
+The XLA path (rca/tpu_backend._score_device) lowers condition evaluation,
+rule matching and scoring to ~15 small HLO ops with [Pi, C]/[Pi, R]
+intermediates bouncing through HBM. Here the entire post-aggregation engine
+is a single VMEM-resident kernel:
+
+  counts_aug [Pi, 128]  --MXU--> cond activations  --VPU--> thresholds/
+  negation --MXU--> rule satisfaction --VPU--> matched / top-1 / scores
+
+Everything after the evidence scatter-add fuses into one pass over a
+[Pi, 128] block (512×128 f32 = 256 KB in VMEM); rule structure enters as
+constant matrices, so condition evaluation is a feature→condition matmul
+instead of per-condition column plucking (lane-dim gathers are the thing
+the MXU is bad at; selection matrices are the thing it is great at).
+
+Gated by settings.use_pallas; tests run it with interpret=True on CPU and
+assert bit-parity with the XLA path.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..graph.schema import DIM, F
+from ..rca.ruleset import (
+    Cond,
+    MULTIPLE_PODS_THRESHOLD,
+    NETWORK_ERRORS_THRESHOLD,
+    NUM_CONDS,
+    NUM_RULES,
+    RULES,
+    UNKNOWN_CONFIDENCE,
+    UNKNOWN_FINAL_SCORE,
+)
+
+LANES = 128           # last-dim tile for f32
+_AUG = DIM            # per_row_max occupies feature column DIM (within LANES)
+
+
+def _build_static_tables() -> dict[str, np.ndarray]:
+    """Selection/threshold/negation/rule matrices, padded to lane width."""
+    sel = np.zeros((LANES, LANES), np.float32)        # feature -> condition
+    thresh = np.zeros((LANES,), np.float32)
+    negate = np.zeros((LANES,), np.float32)
+
+    def s(cond: Cond, features: list[int], t: float, neg: bool = False):
+        for f in features:
+            sel[f, int(cond)] = 1.0
+        thresh[int(cond)] = t
+        negate[int(cond)] = 1.0 if neg else 0.0
+
+    s(Cond.WAITING_CRASHLOOP, [F.W_CRASHLOOPBACKOFF], 0.5)
+    s(Cond.WAITING_IMAGE_PULL,
+      [F.W_IMAGEPULLBACKOFF, F.W_ERRIMAGEPULL, F.W_IMAGEINSPECTERROR], 0.5)
+    s(Cond.TERMINATED_OOM, [F.T_OOMKILLED], 0.5)
+    s(Cond.TERMINATED_CONFIG,
+      [F.T_CONTAINERCANNOTRUN, F.T_CREATECONTAINERCONFIGERROR], 0.5)
+    s(Cond.RECENT_DEPLOY, [F.HAS_RECENT_DEPLOY], 0.5)
+    s(Cond.NO_RECENT_DEPLOY, [F.HAS_RECENT_DEPLOY], 0.5, neg=True)
+    s(Cond.MEMORY_USAGE_HIGH, [F.MEMORY_USAGE_HIGH], 0.5)
+    s(Cond.HPA_AT_MAX, [F.HPA_AT_MAX], 0.5)
+    s(Cond.LATENCY_HIGH, [F.LATENCY_HIGH], 0.5)
+    s(Cond.LOG_PATTERN_NETWORK,
+      [F.LOG_NETWORK, F.LOG_CONNECTION, F.LOG_TIMEOUT], 0.5)
+    s(Cond.NODE_UNHEALTHY, [F.NODE_NOT_READY], 0.5)
+    s(Cond.MULTIPLE_PODS_SAME_NODE, [_AUG], float(MULTIPLE_PODS_THRESHOLD))
+    s(Cond.POD_NOT_READY, [F.POD_NOT_READY], 0.5)
+    s(Cond.READINESS_PROBE_FAILING, [F.READINESS_PROBE_FAILING], 0.5)
+    s(Cond.NETWORK_ERRORS_HIGH, [F.NETWORK_ERROR_COUNT],
+      float(NETWORK_ERRORS_THRESHOLD))
+
+    rule_cond = np.zeros((LANES, LANES), np.float32)  # condition -> rule
+    rule_req = np.zeros((LANES,), np.float32)
+    final_scores = np.zeros((LANES,), np.float32)
+    confidences = np.zeros((LANES,), np.float32)
+    for i, rule in enumerate(RULES):
+        for c in rule.conditions:
+            rule_cond[int(c), i] = 1.0
+        rule_req[i] = len(rule.conditions)
+        final_scores[i] = rule.final_score
+        confidences[i] = rule.confidence
+    # padded rule columns require > NUM_CONDS conditions -> never match
+    rule_req[NUM_RULES:] = LANES + 1.0
+    return {
+        "sel": sel, "thresh": thresh, "negate": negate,
+        "rule_cond": rule_cond, "rule_req": rule_req,
+        "final_scores": final_scores, "confidences": confidences,
+    }
+
+
+_T = _build_static_tables()
+
+
+def _rules_kernel(counts_ref, sel_ref, thresh_ref, negate_ref,
+                  rule_cond_ref, rule_req_ref, scores_tbl_ref, conf_tbl_ref,
+                  conds_ref, matched_ref, scores_ref, meta_ref):
+    counts = counts_ref[:]                                        # [Pi, 128]
+    # feature -> condition activations (MXU)
+    act = jnp.dot(counts, sel_ref[:], preferred_element_type=jnp.float32)
+    raw = (act >= thresh_ref[:][None, :]).astype(jnp.float32)     # [Pi, 128]
+    neg = negate_ref[:][None, :]
+    conds = raw * (1.0 - neg) + (1.0 - raw) * neg                 # XOR negate
+    # mask padded condition columns so negation can't invent conditions
+    col = jax.lax.broadcasted_iota(jnp.int32, conds.shape, dimension=1)
+    conds = jnp.where(col < NUM_CONDS, conds, 0.0)
+    conds_ref[:] = conds
+
+    # condition -> rule satisfaction counts (MXU), all-required AND
+    sat = jnp.dot(conds, rule_cond_ref[:], preferred_element_type=jnp.float32)
+    matched = (sat >= rule_req_ref[:][None, :]).astype(jnp.float32)
+    matched_ref[:] = matched
+
+    scores = matched * scores_tbl_ref[:][None, :]
+    scores_ref[:] = scores
+
+    any_match = jnp.max(matched, axis=1)                          # [Pi]
+    top_idx = jnp.argmax(scores, axis=1).astype(jnp.float32)
+    top_score = jnp.where(any_match > 0, jnp.max(scores, axis=1),
+                          UNKNOWN_FINAL_SCORE)
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, scores.shape, dimension=1)
+              == top_idx.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    conf = jnp.sum(onehot * conf_tbl_ref[:][None, :], axis=1)
+    top_conf = jnp.where(any_match > 0, conf, UNKNOWN_CONFIDENCE)
+    # pack the four per-incident outputs into lane columns 0..3
+    col4 = jax.lax.broadcasted_iota(jnp.int32, scores.shape, dimension=1)
+    meta = (jnp.where(col4 == 0, top_idx[:, None], 0.0)
+            + jnp.where(col4 == 1, any_match[:, None], 0.0)
+            + jnp.where(col4 == 2, top_conf[:, None], 0.0)
+            + jnp.where(col4 == 3, top_score[:, None], 0.0))
+    meta_ref[:] = meta
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fused_rules_engine(counts: jax.Array, per_row_max: jax.Array,
+                       interpret: bool = False):
+    """Run the fused kernel.
+
+    counts: [Pi, DIM] evidence-aggregated features;
+    per_row_max: [Pi] max problem-pods-per-node.
+    Returns (conds [Pi,C] bool, matched [Pi,R] bool, scores [Pi,R],
+    top_idx [Pi] i32, any [Pi] bool, top_conf [Pi], top_score [Pi]).
+    """
+    pi = counts.shape[0]
+    aug = jnp.zeros((pi, LANES), jnp.float32)
+    aug = aug.at[:, :counts.shape[1]].set(counts)
+    aug = aug.at[:, _AUG].set(per_row_max)
+
+    vec = lambda name: jnp.asarray(_T[name])
+    out_shapes = (
+        jax.ShapeDtypeStruct((pi, LANES), jnp.float32),  # conds
+        jax.ShapeDtypeStruct((pi, LANES), jnp.float32),  # matched
+        jax.ShapeDtypeStruct((pi, LANES), jnp.float32),  # scores
+        jax.ShapeDtypeStruct((pi, LANES), jnp.float32),  # meta (4 cols used)
+    )
+    conds, matched, scores, meta = pl.pallas_call(
+        _rules_kernel,
+        out_shape=out_shapes,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        out_specs=tuple(pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(4)),
+        interpret=interpret,
+    )(aug, vec("sel"), vec("thresh"), vec("negate"), vec("rule_cond"),
+      vec("rule_req"), vec("final_scores"), vec("confidences"))
+
+    return (
+        conds[:, :NUM_CONDS] > 0,
+        matched[:, :NUM_RULES] > 0,
+        scores[:, :NUM_RULES],
+        meta[:, 0].astype(jnp.int32),
+        meta[:, 1] > 0,
+        meta[:, 2],
+        meta[:, 3],
+    )

@@ -146,6 +146,37 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     )
 
 
+def _aggregate(features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod,
+               pair_mask, pair_rows, pair_rows_mask,
+               padded_incidents: int, num_pairs: int):
+    """Evidence fold shared by the XLA and Pallas scoring paths."""
+    # fold evidence features per incident: one scatter-add
+    vals = features[ev_dst] * ev_mask[:, None]                       # [Pe, DIM]
+    counts = jnp.zeros((padded_incidents, features.shape[1]), jnp.float32
+                       ).at[ev_rows].add(vals)                       # [Pi, DIM]
+    # multiple-pods-same-node: per (incident,node) problem-pod count,
+    # then per-incident max
+    problem = features[:, F.POD_PROBLEM][pair_pod] * pair_mask       # [Pc]
+    per_pair = jnp.zeros((num_pairs,), jnp.float32).at[pair_ids].add(problem)
+    per_row_max = jnp.zeros((padded_incidents,), jnp.float32
+                            ).at[pair_rows].max(per_pair * pair_rows_mask)
+    return counts, per_row_max
+
+
+@partial(jax.jit, static_argnames=("padded_incidents", "num_pairs", "interpret"))
+def _score_device_pallas(
+    features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
+    pair_rows, pair_rows_mask, padded_incidents: int, num_pairs: int,
+    interpret: bool = False,
+):
+    """Aggregation + the fused Pallas rules kernel (ops/pallas_rules.py)."""
+    from ..ops.pallas_rules import fused_rules_engine
+    counts, per_row_max = _aggregate(
+        features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
+        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+    return fused_rules_engine(counts, per_row_max, interpret=interpret)
+
+
 @partial(jax.jit, static_argnames=("padded_incidents", "num_pairs"))
 def _score_device(
     features: jax.Array,       # [Pn, DIM]
@@ -160,17 +191,9 @@ def _score_device(
     padded_incidents: int,
     num_pairs: int,
 ):
-    # 1) fold evidence features per incident: one scatter-add
-    vals = features[ev_dst] * ev_mask[:, None]                       # [Pe, DIM]
-    counts = jnp.zeros((padded_incidents, features.shape[1]), jnp.float32
-                       ).at[ev_rows].add(vals)                       # [Pi, DIM]
-
-    # 2) multiple-pods-same-node: per (incident,node) problem-pod count,
-    #    then per-incident max
-    problem = features[:, F.POD_PROBLEM][pair_pod] * pair_mask       # [Pc]
-    per_pair = jnp.zeros((num_pairs,), jnp.float32).at[pair_ids].add(problem)
-    per_row_max = jnp.zeros((padded_incidents,), jnp.float32
-                            ).at[pair_rows].max(per_pair * pair_rows_mask)
+    counts, per_row_max = _aggregate(
+        features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
+        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
 
     # 3) condition vector [Pi, NUM_CONDS]
     c = counts
@@ -223,7 +246,11 @@ class TpuRcaBackend:
 
     name = "tpu"
 
-    def __init__(self) -> None:
+    def __init__(self, use_pallas: bool | None = None) -> None:
+        if use_pallas is None:
+            from ..config import get_settings
+            use_pallas = get_settings().use_pallas
+        self.use_pallas = use_pallas
         self._cached_snapshot: GraphSnapshot | None = None  # strong ref: keeps
         # id()s from being reused while the cache lives
         self._device_args: tuple | None = None
@@ -255,11 +282,19 @@ class TpuRcaBackend:
         batch, args, prep_s = self._load(snapshot)
 
         t1 = time.perf_counter()
-        out = _score_device(
-            *args,
-            padded_incidents=batch.padded_incidents,
-            num_pairs=int(batch.pair_rows.shape[0]),
-        )
+        if self.use_pallas:
+            out = _score_device_pallas(
+                *args,
+                padded_incidents=batch.padded_incidents,
+                num_pairs=int(batch.pair_rows.shape[0]),
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            out = _score_device(
+                *args,
+                padded_incidents=batch.padded_incidents,
+                num_pairs=int(batch.pair_rows.shape[0]),
+            )
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
             jax.device_get(out))  # one batched readback
         device_s = time.perf_counter() - t1

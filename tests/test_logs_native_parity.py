@@ -1,0 +1,28 @@
+"""LogsCollector native-vs-python scan parity on scenario logs."""
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu import native
+from kubernetes_aiops_evidence_graph_tpu.collectors.logs import LogsCollector
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+SETTINGS = load_settings()
+
+
+@pytest.mark.parametrize("scenario", ["network", "oom", "crashloop_deploy"])
+def test_native_and_python_scan_agree(scenario, monkeypatch):
+    cluster = generate_cluster(num_pods=60, seed=8)
+    incident = inject(cluster, scenario, sorted(cluster.deployments)[0],
+                      np.random.default_rng(8))
+    collector = LogsCollector(cluster, SETTINGS)
+    lines = cluster.query_logs(incident.namespace, incident.service, limit=1000)
+    if not lines:
+        pytest.skip("scenario emits no logs")
+
+    native_result = collector._scan(lines)
+    if not native.available():
+        pytest.skip("native library unavailable")
+    monkeypatch.setattr(native, "available", lambda: False)
+    python_result = collector._scan(lines)
+    assert native_result == python_result

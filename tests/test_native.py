@@ -1,0 +1,89 @@
+"""Native C++ kernels: build, run, and agree with the Python implementations."""
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu import native
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native.available():
+        pytest.skip("native library unavailable (no g++?)")
+
+
+def test_scan_logs_matches_python_regexes(lib_available):
+    from kubernetes_aiops_evidence_graph_tpu.collectors.logs import ERROR_PATTERNS
+    lines = [
+        "ERROR dial tcp 10.0.0.7:5432: connection refused",
+        "WARN upstream request timeout after 5s",
+        "terror in the aisles",              # must NOT match 'error' (\\b)
+        "CRITICAL panic: nil pointer dereference",
+        "disk full on /var",
+        "x509: certificate signed by unknown authority",
+        "all good here",
+        "Out of memory: killed process 1234",
+    ]
+    counts, flags = native.scan_logs_native(lines)
+    # python-side truth
+    py_counts = {cat: sum(1 for ln in lines if rx.search(ln))
+                 for cat, rx in ERROR_PATTERNS.items()}
+    for cat in py_counts:
+        assert counts[cat] == py_counts[cat], (
+            f"{cat}: native {counts[cat]} != python {py_counts[cat]}")
+    assert len(flags) == len(lines)
+    assert flags[6] == 0  # clean line matches nothing
+
+
+def test_khop_reach_matches_store_bfs(lib_available):
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import build_snapshot
+    from tests.test_rca_parity import run_pipeline
+
+    incidents, _, snapshot = run_pipeline(["crashloop_deploy"], num_pods=120, seed=3)
+    live = snapshot.edge_mask > 0
+    seed_idx = int(snapshot.incident_nodes[0])
+    reach = native.khop_reach_native(
+        snapshot.edge_src[live], snapshot.edge_dst[live],
+        snapshot.padded_nodes, seed_idx, hops=2)
+    assert reach is not None and reach[seed_idx] == 1
+
+    # python truth via the jax op
+    import jax.numpy as jnp
+    from kubernetes_aiops_evidence_graph_tpu.ops import k_hop_reach
+    r = k_hop_reach(
+        jnp.asarray([seed_idx], dtype=jnp.int32), jnp.asarray([1.0]),
+        jnp.asarray(snapshot.edge_src), jnp.asarray(snapshot.edge_dst),
+        jnp.asarray(snapshot.edge_mask), num_nodes=snapshot.padded_nodes, hops=2)
+    np.testing.assert_array_equal(reach.astype(np.float32), np.asarray(r)[0])
+
+
+def test_scan_logs_review_regressions(lib_available):
+    """Inputs from code review that previously crashed or diverged."""
+    from kubernetes_aiops_evidence_graph_tpu.collectors.logs import ERROR_PATTERNS
+
+    # embedded newline must not desync/overflow the flags buffer
+    counts, flags = native.scan_logs_native(
+        ["a\nfatal error\nfatal error\nfatal error"])
+    assert len(flags) == 1
+
+    # empty lines keep index alignment
+    counts, flags = native.scan_logs_native(["", "fatal error occurred"])
+    assert len(flags) == 2 and flags[0] == 0 and flags[1] != 0
+
+    # boundary/spelling parity with the python regexes
+    for line, note in [("Dismissing stale cache entry", "no \\b 'missing' hit"),
+                       ("request timedout", "timedout spelling"),
+                       ("networking layer ok", "no bare 'network' hit"),
+                       ("terror in the aisles", "no bare 'error' hit")]:
+        counts, flags = native.scan_logs_native([line])
+        py = {cat for cat, rx in ERROR_PATTERNS.items() if rx.search(line)}
+        nat = {native.LOG_CATEGORIES[i][0]
+               for i in range(len(native.LOG_CATEGORIES)) if int(flags[0]) >> i & 1}
+        assert nat == py, f"{note}: native {nat} != python {py}"
+
+
+def test_khop_isolated_seed(lib_available):
+    src = np.array([0, 1], dtype=np.int32)
+    dst = np.array([1, 0], dtype=np.int32)
+    reach = native.khop_reach_native(src, dst, 4, seed=3, hops=5)
+    assert reach.tolist() == [0, 0, 0, 1]

@@ -1,0 +1,69 @@
+"""Fused Pallas rules kernel: bit-parity with the XLA scoring path on real
+scenario snapshots (interpret mode on the CPU test platform) plus synthetic
+condition-edge cases."""
+import numpy as np
+import jax.numpy as jnp
+
+from kubernetes_aiops_evidence_graph_tpu.graph.schema import DIM, F
+from kubernetes_aiops_evidence_graph_tpu.ops.pallas_rules import fused_rules_engine
+from kubernetes_aiops_evidence_graph_tpu.rca import RULE_INDEX
+from kubernetes_aiops_evidence_graph_tpu.rca.tpu_backend import TpuRcaBackend
+from tests.test_rca_parity import run_pipeline
+
+
+def test_kernel_matches_xla_path_on_scenarios():
+    _, _, snapshot = run_pipeline(
+        ["crashloop_deploy", "oom", "imagepull", "network", "node_pressure",
+         "hpa_maxed", "probe_failure", "config_error", "oom_pressure",
+         "crashloop"], num_pods=300, seed=17)
+    xla = TpuRcaBackend(use_pallas=False)
+    pallas = TpuRcaBackend(use_pallas=True)
+    raw_x = xla.score_snapshot(snapshot)
+    raw_p = pallas.score_snapshot(snapshot)
+    np.testing.assert_array_equal(raw_p["matched"], raw_x["matched"])
+    np.testing.assert_array_equal(raw_p["conditions"], raw_x["conditions"])
+    np.testing.assert_array_equal(raw_p["top_rule_index"], raw_x["top_rule_index"])
+    np.testing.assert_array_equal(raw_p["any_match"], raw_x["any_match"])
+    np.testing.assert_allclose(raw_p["top_confidence"], raw_x["top_confidence"])
+    np.testing.assert_allclose(raw_p["top_score"], raw_x["top_score"])
+
+
+def test_kernel_synthetic_edges():
+    pi = 8
+    counts = np.zeros((pi, DIM), np.float32)
+    per_row_max = np.zeros(pi, np.float32)
+    # row 0: crashloop + recent deploy
+    counts[0, F.W_CRASHLOOPBACKOFF] = 2
+    counts[0, F.HAS_RECENT_DEPLOY] = 1
+    # row 1: crashloop, no deploy
+    counts[1, F.W_CRASHLOOPBACKOFF] = 1
+    # row 2: nothing -> unknown
+    # row 3: network threshold boundary (9 < 10: no match)
+    counts[3, F.LOG_NETWORK] = 5
+    counts[3, F.NETWORK_ERROR_COUNT] = 9
+    # row 4: network at threshold (10: match)
+    counts[4, F.LOG_CONNECTION] = 1
+    counts[4, F.NETWORK_ERROR_COUNT] = 10
+    # row 5: node rule needs BOTH unhealthy node and >=2 pods same node
+    counts[5, F.NODE_NOT_READY] = 1
+    per_row_max[5] = 1  # only one problem pod -> no match (NO_RECENT matches nothing alone)
+    # row 6: node rule satisfied
+    counts[6, F.NODE_NOT_READY] = 1
+    per_row_max[6] = 2
+
+    out = fused_rules_engine(jnp.asarray(counts), jnp.asarray(per_row_max),
+                             interpret=True)
+    conds, matched, scores, top_idx, any_match, top_conf, top_score = map(
+        np.asarray, out)
+
+    assert top_idx[0] == RULE_INDEX["crashloop_recent_deploy"]
+    assert top_idx[1] == RULE_INDEX["crashloop_no_change"]
+    assert not any_match[2]
+    np.testing.assert_allclose(top_conf[2], 0.3, rtol=1e-6)
+    np.testing.assert_allclose(top_score[2], 0.15, rtol=1e-6)
+    assert not matched[3, RULE_INDEX["network_error"]]
+    assert matched[4, RULE_INDEX["network_error"]]
+    assert not matched[5, RULE_INDEX["node_failure_isolated"]]
+    assert matched[6, RULE_INDEX["node_failure_isolated"]]
+    # NO_RECENT_DEPLOY negation never matches rules alone on empty rows
+    assert conds[2, 5] and not any_match[2]
