@@ -5,10 +5,13 @@ cluster tensorized to a ~50k-node evidence graph with 500 concurrent
 incidents. The CPU baseline is this repo's faithful re-implementation of
 the reference rules engine (signal fold + rule match per incident,
 rules_engine.py:200-234 semantics) timed per-incident on a sample and
-scaled to the full incident count; the TPU number is the median wall time
-of the full batched scoring pass (host prep + device + readback) after one
-warmup compile. Accuracy is checked: top-1 must match the CPU oracle on
-every sampled incident, and the expected scenario rule overall.
+scaled to the full incident count; the TPU number is the amortized per-pass device time of
+the batched scoring pass, measured by chaining K dispatches behind a
+single host fetch and taking the slope (the dev tunnel's ~75 ms fetch RTT
+and no-op block_until_ready make single-pass wall timing meaningless —
+see the comment in bench_rca; --calibrate validates the method against a
+known-FLOPs matmul). Accuracy is checked: top-1 must match the CPU oracle
+on every sampled incident, and the expected scenario rule overall.
 
 Prints ONE JSON line:
   {"metric": "rca_speedup_50k_nodes_500_incidents", "value": <speedup>,
@@ -94,17 +97,48 @@ def bench_rca(num_pods: int, num_incidents: int, cpu_sample: int,
         f"-> est {cpu_total_est:.3f}s for {len(incidents)}")
 
     # --- TPU batched ---
+    # Timing methodology: on this harness the TPU is reached through a
+    # tunnel where block_until_ready does NOT wait for execution and any
+    # device->host fetch of a fresh result costs a fixed ~75 ms RTT
+    # regardless of size (measured: 8-float fetch = 78 ms; a 1.1-TFLOP
+    # matmul "completes" under block_until_ready in 0.03 ms). Single-pass
+    # wall timing therefore measures the tunnel, not the TPU. We instead
+    # chain K dispatches behind ONE fetch and take the slope
+    # (t_K - t_1)/(K-1) — the amortized per-pass device time, which is
+    # also exactly the sustained-throughput number a pipelined production
+    # deployment sees. The method is calibrated against a matmul of known
+    # FLOPs (see _calibrate_slope): measured 5.81 ms vs 5.58 ms theoretical
+    # on v5e-1.
+    import jax
+
     tpu = get_backend("tpu")
-    raw = tpu.score_snapshot(snapshot)  # warmup + compile
-    times = []
-    for _ in range(iters):
-        t1 = time.perf_counter()
-        raw = tpu.score_snapshot(snapshot)
-        times.append(time.perf_counter() - t1)
-    tpu_s = statistics.median(times)
-    log(f"tpu: median warm batch {tpu_s*1e3:.2f} ms over {iters} iters "
-        f"(device-resident snapshot; device {raw['device_seconds']*1e3:.2f} ms); "
-        f"p50 per scoring pass = {tpu_s*1e3:.2f} ms")
+    raw = tpu.score_snapshot(snapshot)  # warmup + compile (+ one fetch)
+
+    def run(k: int) -> float:
+        # each pass feeds its top_score back as the next pass's `chain`
+        # input — a true data dependency (see TpuRcaBackend.dispatch), so a
+        # lazy runtime cannot elide the k-1 unfetched passes
+        t0 = time.perf_counter()
+        carry = None
+        out = None
+        for _ in range(k):
+            out = tpu.dispatch(snapshot, chain=carry)
+            carry = out[6]  # top_score [Pi]
+        jax.device_get(out[3])  # single sync point
+        return time.perf_counter() - t0
+
+    t_1 = min(run(1) for _ in range(3))
+    k = max(iters, 100)
+    t_k = min(run(k) for _ in range(2))
+    tpu_s = (t_k - t_1) / (k - 1)
+    if tpu_s < 20e-6:
+        raise SystemExit(
+            f"NON-PHYSICAL SLOPE: {tpu_s*1e6:.2f} us/pass for a "
+            f"{snapshot.padded_nodes}-node scatter — the runtime is not "
+            f"executing chained passes; timing methodology is invalid here")
+    log(f"tpu: amortized per-pass {tpu_s*1e3:.3f} ms over {k} chained passes "
+        f"(single-sync floor {t_1*1e3:.1f} ms = tunnel RTT, excluded); "
+        f"throughput {len(incidents)/tpu_s:,.0f} incidents/s")
 
     # --- accuracy check: TPU top-1 == CPU oracle top-1 on the sample ---
     by_node = {nid: i for i, nid in enumerate(raw["incident_ids"])}
@@ -129,21 +163,56 @@ def bench_labelprop(num_nodes: int, iters: int):
 
     rng = np.random.default_rng(0)
     edges = num_nodes * 4
-    src = rng.integers(0, num_nodes, edges).astype(np.int32)
-    dst = rng.integers(0, num_nodes, edges).astype(np.int32)
-    mask = np.ones(edges, np.float32)
-    x = (rng.random(num_nodes) < 0.01).astype(np.float32)
-    out = propagate_labels(jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst),
-                           jnp.asarray(mask), num_nodes=num_nodes, iterations=3)
-    out.block_until_ready()
-    times = []
-    for _ in range(iters):
+    src = jnp.asarray(rng.integers(0, num_nodes, edges).astype(np.int32))
+    dst = jnp.asarray(rng.integers(0, num_nodes, edges).astype(np.int32))
+    mask = jnp.ones(edges, jnp.float32)
+    x0 = jnp.asarray((rng.random(num_nodes) < 0.01).astype(np.float32))
+
+    def run(k: int) -> float:
         t0 = time.perf_counter()
-        propagate_labels(jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst),
-                         jnp.asarray(mask), num_nodes=num_nodes, iterations=3
-                         ).block_until_ready()
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+        out = x0
+        for _ in range(k):  # chained: each pass consumes the previous
+            out = propagate_labels(out, src, dst, mask,
+                                   num_nodes=num_nodes, iterations=3)
+        jax.device_get(out[0])  # single sync (see bench_rca on tunnel RTT)
+        return time.perf_counter() - t0
+
+    run(1)  # warm compile
+    t1 = min(run(1) for _ in range(3))
+    k = max(iters, 50)
+    tk = min(run(k) for _ in range(2))
+    return max((tk - t1) / (k - 1), 1e-9)
+
+
+def _calibrate_slope() -> None:
+    """Validate the K-pass slope methodology against known-FLOPs matmuls.
+
+    A [8192]^3 bf16 matmul is 1.10 TFLOP; v5e-1 peak is ~197 TFLOP/s bf16,
+    so the slope should read ~5.6 ms if (and only if) the method measures
+    real device execution. Prints the comparison to stderr."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    f = jax.jit(lambda a, b: a @ b)
+    a = jnp.ones((n, n), jnp.bfloat16)
+    jax.device_get(f(a, a)[0, 0])  # warm
+
+    def run(k: int) -> float:
+        t0 = time.perf_counter()
+        out = a
+        for _ in range(k):
+            out = f(out, a)
+        jax.device_get(out[0, 0])
+        return time.perf_counter() - t0
+
+    t1 = min(run(1) for _ in range(3))
+    t50 = run(50)
+    slope_ms = (t50 - t1) / 49 * 1e3
+    flops = 2 * n**3
+    print(f"calibration: matmul slope {slope_ms:.2f} ms = "
+          f"{flops/slope_ms/1e9:.0f} TFLOP/s (v5e peak ~197 bf16); "
+          f"sync floor {t1*1e3:.1f} ms", file=sys.stderr)
 
 
 def ensure_responsive_device(probe_timeout_s: int = 120) -> str:
@@ -229,8 +298,13 @@ def main(argv=None) -> int:
                     help="BASELINE config index: 0=200pod/1inc 1=1k/20 3=50k/500")
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--cpu-sample", type=int, default=50)
+    ap.add_argument("--calibrate", action="store_true",
+                    help="validate the slope timing method against a "
+                         "known-FLOPs matmul first")
     args = ap.parse_args(argv)
-    ensure_responsive_device()
+    platform = ensure_responsive_device()
+    if args.calibrate and platform == "tpu":
+        _calibrate_slope()
 
     if args.config == 4 and not args.smoke:
         eps, rescore_p50 = bench_streaming(10_000, 100, events=2000)

@@ -43,6 +43,10 @@ class _Edge:
 class EvidenceGraphStore:
     """Mutable, thread-safe, in-memory property graph."""
 
+    # below this many nodes the Python BFS beats the cost of materializing
+    # the COO index for the native kernel
+    _NATIVE_BFS_MIN_NODES = 2048
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._nodes: dict[str, _Node] = {}
@@ -50,6 +54,7 @@ class EvidenceGraphStore:
         self._out: dict[str, set[tuple[str, RelationKind]]] = {}
         self._in: dict[str, set[tuple[str, RelationKind]]] = {}
         self._version = 0  # bumps on every mutation; snapshot cache key
+        self._coo_cache: tuple[int, list[str], Any, Any] | None = None
 
     # -- mutation ---------------------------------------------------------
 
@@ -160,34 +165,42 @@ class EvidenceGraphStore:
                 out += [(s, RelationKind(k).name) for s, k in self._in.get(node_id, ())]
             return out
 
+    def _undirected_coo(self) -> tuple[list[str], Any, Any]:
+        """Version-cached undirected COO edge index for the native BFS
+        kernel. Caller must hold the lock."""
+        import numpy as np
+
+        if self._coo_cache is not None and self._coo_cache[0] == self._version:
+            return self._coo_cache[1], self._coo_cache[2], self._coo_cache[3]
+        nodes = sorted(self._nodes.values(), key=lambda n: n.index)
+        ids = [n.id for n in nodes]
+        row = {n.id: i for i, n in enumerate(nodes)}
+        m = len(self._edges)
+        src = np.empty(2 * m, dtype=np.int32)
+        dst = np.empty(2 * m, dtype=np.int32)
+        for i, e in enumerate(self._edges.values()):
+            s, d = row[e.src], row[e.dst]
+            src[i], dst[i] = s, d
+            src[m + i], dst[m + i] = d, s     # reverse edge: BFS is undirected
+        self._coo_cache = (self._version, ids, src, dst)
+        return ids, src, dst
+
     def get_incident_subgraph(self, incident_id: str, depth: int = 3) -> dict[str, Any]:
         """Depth-limited undirected subgraph around an incident — the
-        reference's apoc.path.subgraphAll(maxLevel=depth) (neo4j.py:169-201),
-        implemented as BFS over the in-memory adjacency."""
+        reference's apoc.path.subgraphAll(maxLevel=depth) (neo4j.py:169-201).
+        Large graphs use the native C++ BFS kernel (native/kaeg_native.cpp
+        khop_reach) over a version-cached COO index; small graphs and
+        toolchain-less installs use the Python BFS."""
         nid = incident_id if incident_id.startswith("incident:") else f"incident:{incident_id}"
         with self._lock:
             if nid not in self._nodes:
                 return {"nodes": [], "relationships": []}
-            seen = {nid}
-            frontier = [nid]
-            for _ in range(depth):
-                nxt = []
-                for cur in frontier:
-                    for d, _k in self._out.get(cur, ()):
-                        if d not in seen:
-                            seen.add(d)
-                            nxt.append(d)
-                    for s, _k in self._in.get(cur, ()):
-                        if s not in seen:
-                            seen.add(s)
-                            nxt.append(s)
-                frontier = nxt
-                if not frontier:
-                    break
+            seen = self._bfs_reach(nid, depth)
             nodes = [
                 {"id": n.id, "type": n.label, "properties": dict(n.properties)}
                 for n in (self._nodes[i] for i in seen)
             ]
+            nodes.sort(key=lambda n: n["id"])
             rels = [
                 {"source": e.src, "target": e.dst, "type": RelationKind(e.kind).name,
                  "properties": dict(e.properties)}
@@ -195,6 +208,35 @@ class EvidenceGraphStore:
                 if e.src in seen and e.dst in seen
             ]
             return {"nodes": nodes, "relationships": rels}
+
+    def _bfs_reach(self, nid: str, depth: int) -> set[str]:
+        """Node ids within `depth` undirected hops of `nid` (inclusive).
+        Caller must hold the lock."""
+        if len(self._nodes) >= self._NATIVE_BFS_MIN_NODES:
+            from .. import native as _native
+            if _native.available():
+                ids, src, dst = self._undirected_coo()
+                seed = self._nodes[nid].index
+                reach = _native.khop_reach_native(src, dst, len(ids), seed, depth)
+                if reach is not None:
+                    return {ids[i] for i in reach.nonzero()[0]}
+        seen = {nid}
+        frontier = [nid]
+        for _ in range(depth):
+            nxt = []
+            for cur in frontier:
+                for d, _k in self._out.get(cur, ()):
+                    if d not in seen:
+                        seen.add(d)
+                        nxt.append(d)
+                for s, _k in self._in.get(cur, ()):
+                    if s not in seen:
+                        seen.add(s)
+                        nxt.append(s)
+            frontier = nxt
+            if not frontier:
+                break
+        return seen
 
     def find_related_changes(
         self,

@@ -78,9 +78,11 @@ _CAT_BLOB = "\n".join(alts for _, alts, _b in LOG_CATEGORIES).encode()
 _BOUND_MASK = sum((1 << i) for i, (_, _, b) in enumerate(LOG_CATEGORIES) if b)
 
 
-def scan_logs_native(lines: list[str], max_lines: int = 100000):
+def scan_logs_native(lines: list[str], max_lines: int | None = None):
     """Returns (counts per category, per-line category bitmasks aligned with
-    `lines`) or None if the native library is unavailable."""
+    `lines`) or None if the native library is unavailable. Scans every line
+    unless `max_lines` caps it (the returned flags array then has only
+    `max_lines` entries — callers must not index past it)."""
     lib = _load()
     if lib is None:
         return None
@@ -88,7 +90,7 @@ def scan_logs_native(lines: list[str], max_lines: int = 100000):
         return ({name: 0 for name, _a, _b in LOG_CATEGORIES},
                 np.zeros(0, dtype=np.uint64))
     # embedded newlines would desync line indexing — flatten them
-    n_lines = min(len(lines), max_lines)
+    n_lines = len(lines) if max_lines is None else min(len(lines), max_lines)
     buf = "\n".join(l.replace("\n", " ") for l in lines[:n_lines]
                     ).encode("utf-8", "replace")
     counts = (ctypes.c_int64 * len(LOG_CATEGORIES))()
@@ -103,12 +105,22 @@ def scan_logs_native(lines: list[str], max_lines: int = 100000):
 
 def khop_reach_native(edge_src: np.ndarray, edge_dst: np.ndarray,
                       num_nodes: int, seed: int, hops: int):
-    """BFS reach mask uint8 [num_nodes], or None if unavailable."""
+    """BFS reach mask uint8 [num_nodes], or None if unavailable.
+
+    Indices are validated here — the C++ kernel does raw array writes, so
+    an out-of-range seed raises and out-of-range edges (e.g. unfiltered
+    padding) are dropped rather than corrupting memory."""
     lib = _load()
     if lib is None:
         return None
+    if not 0 <= seed < num_nodes:
+        raise ValueError(f"seed {seed} out of range [0, {num_nodes})")
     src = np.ascontiguousarray(edge_src, dtype=np.int32)
     dst = np.ascontiguousarray(edge_dst, dtype=np.int32)
+    valid = (src >= 0) & (src < num_nodes) & (dst >= 0) & (dst < num_nodes)
+    if not valid.all():
+        src = np.ascontiguousarray(src[valid])
+        dst = np.ascontiguousarray(dst[valid])
     reach = np.zeros(num_nodes, dtype=np.uint8)
     lib.khop_reach(
         src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),

@@ -92,6 +92,10 @@ def _build_static_tables() -> dict[str, np.ndarray]:
         "sel": sel, "thresh": thresh, "negate": negate,
         "rule_cond": rule_cond, "rule_req": rule_req,
         "final_scores": final_scores, "confidences": confidences,
+        # f32 lane indices as a constant input: Mosaic (this toolchain) cannot
+        # legalize vector sitofp/uitofp, so the kernel must never convert
+        # int iota -> float; it selects against this table instead.
+        "lane_idx": np.arange(LANES, dtype=np.float32),
     }
 
 
@@ -100,11 +104,16 @@ _T = _build_static_tables()
 
 def _rules_kernel(counts_ref, sel_ref, thresh_ref, negate_ref,
                   rule_cond_ref, rule_req_ref, scores_tbl_ref, conf_tbl_ref,
+                  lane_idx_ref,
                   conds_ref, matched_ref, scores_ref, meta_ref):
+    # NOTE: no int->float converts anywhere — Mosaic on this toolchain fails
+    # to legalize vector sitofp/uitofp, so booleans become floats via
+    # jnp.where(pred, 1.0, 0.0) and argmax is a float min-select over the
+    # constant lane_idx table.
     counts = counts_ref[:]                                        # [Pi, 128]
     # feature -> condition activations (MXU)
     act = jnp.dot(counts, sel_ref[:], preferred_element_type=jnp.float32)
-    raw = (act >= thresh_ref[:][None, :]).astype(jnp.float32)     # [Pi, 128]
+    raw = jnp.where(act >= thresh_ref[:][None, :], 1.0, 0.0)      # [Pi, 128]
     neg = negate_ref[:][None, :]
     conds = raw * (1.0 - neg) + (1.0 - raw) * neg                 # XOR negate
     # mask padded condition columns so negation can't invent conditions
@@ -114,18 +123,21 @@ def _rules_kernel(counts_ref, sel_ref, thresh_ref, negate_ref,
 
     # condition -> rule satisfaction counts (MXU), all-required AND
     sat = jnp.dot(conds, rule_cond_ref[:], preferred_element_type=jnp.float32)
-    matched = (sat >= rule_req_ref[:][None, :]).astype(jnp.float32)
+    matched = jnp.where(sat >= rule_req_ref[:][None, :], 1.0, 0.0)
     matched_ref[:] = matched
 
     scores = matched * scores_tbl_ref[:][None, :]
     scores_ref[:] = scores
 
     any_match = jnp.max(matched, axis=1)                          # [Pi]
-    top_idx = jnp.argmax(scores, axis=1).astype(jnp.float32)
-    top_score = jnp.where(any_match > 0, jnp.max(scores, axis=1),
-                          UNKNOWN_FINAL_SCORE)
-    onehot = (jax.lax.broadcasted_iota(jnp.int32, scores.shape, dimension=1)
-              == top_idx.astype(jnp.int32)[:, None]).astype(jnp.float32)
+    top_score_m = jnp.max(scores, axis=1)                         # [Pi]
+    idxf = lane_idx_ref[:][None, :]                               # [1, 128]
+    # first (lowest-index) maximal score == argmax's tie-break == the CPU
+    # oracle's stable sort by rule-table order
+    is_max = scores >= top_score_m[:, None]
+    top_idx = jnp.min(jnp.where(is_max, idxf, float(LANES)), axis=1)  # f32
+    top_score = jnp.where(any_match > 0, top_score_m, UNKNOWN_FINAL_SCORE)
+    onehot = jnp.where(idxf == top_idx[:, None], 1.0, 0.0)
     conf = jnp.sum(onehot * conf_tbl_ref[:][None, :], axis=1)
     top_conf = jnp.where(any_match > 0, conf, UNKNOWN_CONFIDENCE)
     # pack the four per-incident outputs into lane columns 0..3
@@ -162,11 +174,12 @@ def fused_rules_engine(counts: jax.Array, per_row_max: jax.Array,
     conds, matched, scores, meta = pl.pallas_call(
         _rules_kernel,
         out_shape=out_shapes,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 9,
         out_specs=tuple(pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(4)),
         interpret=interpret,
     )(aug, vec("sel"), vec("thresh"), vec("negate"), vec("rule_cond"),
-      vec("rule_req"), vec("final_scores"), vec("confidences"))
+      vec("rule_req"), vec("final_scores"), vec("confidences"),
+      vec("lane_idx"))
 
     return (
         conds[:, :NUM_CONDS] > 0,

@@ -133,6 +133,7 @@ class StreamingScorer:
         t1 = time.perf_counter()
         out = _score_device(
             self._features_dev, *self._edge_args,
+            jnp.zeros((self._batch.padded_incidents,), jnp.float32),  # chain
             padded_incidents=self._batch.padded_incidents,
             num_pairs=int(self._batch.pair_rows.shape[0]),
         )

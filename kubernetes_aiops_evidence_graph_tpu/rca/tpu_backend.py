@@ -166,7 +166,7 @@ def _aggregate(features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod,
 @partial(jax.jit, static_argnames=("padded_incidents", "num_pairs", "interpret"))
 def _score_device_pallas(
     features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
-    pair_rows, pair_rows_mask, padded_incidents: int, num_pairs: int,
+    pair_rows, pair_rows_mask, chain, padded_incidents: int, num_pairs: int,
     interpret: bool = False,
 ):
     """Aggregation + the fused Pallas rules kernel (ops/pallas_rules.py)."""
@@ -174,6 +174,7 @@ def _score_device_pallas(
     counts, per_row_max = _aggregate(
         features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
         pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+    counts = counts + jnp.minimum(chain, 0.0)[:, None]  # see dispatch()
     return fused_rules_engine(counts, per_row_max, interpret=interpret)
 
 
@@ -188,12 +189,14 @@ def _score_device(
     pair_mask: jax.Array,      # [Pc]
     pair_rows: jax.Array,      # [Pp]
     pair_rows_mask: jax.Array, # [Pp]
+    chain: jax.Array,          # [Pi] — see dispatch()
     padded_incidents: int,
     num_pairs: int,
 ):
     counts, per_row_max = _aggregate(
         features, ev_rows, ev_dst, ev_mask, pair_ids, pair_pod, pair_mask,
         pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+    counts = counts + jnp.minimum(chain, 0.0)[:, None]
 
     # 3) condition vector [Pi, NUM_CONDS]
     c = counts
@@ -273,28 +276,47 @@ class TpuRcaBackend:
         self._cached_snapshot, self._batch, self._device_args = snapshot, batch, args
         return batch, args, time.perf_counter() - t0
 
+    def dispatch(self, snapshot: GraphSnapshot, chain: jax.Array | None = None
+                 ) -> tuple:
+        """Enqueue one scoring pass; returns *device* arrays, no host fetch.
+
+        This is the unit the benchmark times (device results can be consumed
+        by downstream device work or fetched asynchronously; on the dev
+        tunnel a synchronous fetch costs a fixed ~75 ms RTT that has nothing
+        to do with the TPU).
+
+        `chain` (f32 [padded_incidents]) lets back-to-back passes carry a
+        true data dependency so no runtime can elide unfetched passes: the
+        caller feeds the previous pass's top_score back in, and the kernels
+        add ``min(chain, 0)`` to the aggregated counts — scores are always
+        >= 0, so the result is bit-identical, but the compiler cannot prove
+        that and must execute every pass in order."""
+        batch, args, _ = self._load(snapshot)
+        if chain is None:
+            chain = jnp.zeros((batch.padded_incidents,), jnp.float32)
+        if self.use_pallas:
+            return _score_device_pallas(
+                *args, chain,
+                padded_incidents=batch.padded_incidents,
+                num_pairs=int(batch.pair_rows.shape[0]),
+                interpret=jax.default_backend() != "tpu",
+            )
+        return _score_device(
+            *args, chain,
+            padded_incidents=batch.padded_incidents,
+            num_pairs=int(batch.pair_rows.shape[0]),
+        )
+
     def score_snapshot(self, snapshot: GraphSnapshot) -> dict:
         """Score every incident in the snapshot in one device pass.
 
         Returns a dict of host numpy arrays keyed by incident order
         (snapshot.incident_ids); use :meth:`results` for model objects.
         """
-        batch, args, prep_s = self._load(snapshot)
+        _, _, prep_s = self._load(snapshot)  # dispatch() below hits the cache
 
         t1 = time.perf_counter()
-        if self.use_pallas:
-            out = _score_device_pallas(
-                *args,
-                padded_incidents=batch.padded_incidents,
-                num_pairs=int(batch.pair_rows.shape[0]),
-                interpret=jax.default_backend() != "tpu",
-            )
-        else:
-            out = _score_device(
-                *args,
-                padded_incidents=batch.padded_incidents,
-                num_pairs=int(batch.pair_rows.shape[0]),
-            )
+        out = self.dispatch(snapshot)
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
             jax.device_get(out))  # one batched readback
         device_s = time.perf_counter() - t1

@@ -9,9 +9,10 @@
 //     edge lists (the apoc.path.subgraphAll analog, neo4j.py:169-201) for
 //     the API graph endpoint at 50k-node scale.
 //
-// Built via `python -m kubernetes_aiops_evidence_graph_tpu.native_build`
-// (g++ -O3 -shared); loaded with ctypes; every caller has a pure-Python
-// fallback so the wheel works without a toolchain.
+// Built lazily on first use by kubernetes_aiops_evidence_graph_tpu/native.py
+// (_load(): g++ -O3 -shared, cached next to this source); loaded with
+// ctypes; every caller has a pure-Python fallback so the package works
+// without a toolchain.
 #include <cstdint>
 #include <cstring>
 #include <cctype>

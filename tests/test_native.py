@@ -87,3 +87,50 @@ def test_khop_isolated_seed(lib_available):
     dst = np.array([1, 0], dtype=np.int32)
     reach = native.khop_reach_native(src, dst, 4, seed=3, hops=5)
     assert reach.tolist() == [0, 0, 0, 1]
+
+
+def test_khop_bounds_validation(lib_available):
+    src = np.array([0, 9, -1], dtype=np.int32)   # 9 and -1 out of range
+    dst = np.array([1, 0, 2], dtype=np.int32)
+    reach = native.khop_reach_native(src, dst, 3, seed=0, hops=2)
+    assert reach.tolist() == [1, 1, 0]           # bad edges dropped, no crash
+    with pytest.raises(ValueError):
+        native.khop_reach_native(src, dst, 3, seed=7, hops=1)
+    with pytest.raises(ValueError):
+        native.khop_reach_native(src, dst, 3, seed=-1, hops=1)
+
+
+def test_store_subgraph_native_path_matches_python(lib_available):
+    """Above _NATIVE_BFS_MIN_NODES the store routes BFS through the C++
+    kernel; result must equal the pure-Python BFS on the same graph."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.store import EvidenceGraphStore
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphEntity, GraphRelation
+
+    rng = np.random.default_rng(0)
+    n = EvidenceGraphStore._NATIVE_BFS_MIN_NODES + 50
+    store = EvidenceGraphStore()
+    store.upsert_entities([
+        GraphEntity(id="incident:i1", type="Incident", properties={})
+    ] + [GraphEntity(id=f"pod:p{i}", type="Pod", properties={}) for i in range(n)])
+    rels = [GraphRelation(source_id="incident:i1", target_id="pod:p0",
+                          relation_type="AFFECTS")]
+    for i in range(n - 1):  # chain + random shortcuts
+        rels.append(GraphRelation(source_id=f"pod:p{i}", target_id=f"pod:p{i+1}",
+                                  relation_type="CALLS"))
+    for _ in range(200):
+        a, b = rng.integers(0, n, 2)
+        rels.append(GraphRelation(source_id=f"pod:p{a}", target_id=f"pod:p{b}",
+                                  relation_type="CALLS"))
+    store.upsert_relations(rels)
+    assert store.node_count() > EvidenceGraphStore._NATIVE_BFS_MIN_NODES
+
+    py = EvidenceGraphStore()  # same graph, python BFS forced via threshold
+    py._nodes, py._edges = store._nodes, store._edges
+    py._out, py._in = store._out, store._in
+    py._NATIVE_BFS_MIN_NODES = 10**9
+    for depth in (1, 2, 3):
+        native_ids = {x["id"] for x in
+                      store.get_incident_subgraph("i1", depth=depth)["nodes"]}
+        with py._lock:
+            py_ids = py._bfs_reach("incident:i1", depth)
+        assert native_ids == py_ids
