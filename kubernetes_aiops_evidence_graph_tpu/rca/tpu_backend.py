@@ -272,7 +272,6 @@ class TpuRcaBackend:
             jnp.asarray(batch.pair_mask),
             jnp.asarray(batch.pair_rows), jnp.asarray(batch.pair_rows_mask),
         )
-        jax.block_until_ready(args)
         self._cached_snapshot, self._batch, self._device_args = snapshot, batch, args
         return batch, args, time.perf_counter() - t0
 
