@@ -1,0 +1,32 @@
+# kaeg-tpu runtime image.
+#
+# Parity with the reference Dockerfile (reference Dockerfile:1-36) minus its
+# defects: the served module actually exists (reference CMD pointed at a
+# missing src/main.py, SURVEY.md §3.6 item 1) and no nonexistent tests/ COPY.
+# The TPU runtime (libtpu + jax[tpu]) is provided by the host image on TPU
+# VMs; this image carries the CPU fallback so the ingestion edge and CPU RCA
+# backend run anywhere.
+FROM python:3.11-slim
+
+WORKDIR /app
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ curl ca-certificates \
+    && rm -rf /var/lib/apt/lists/* \
+    && curl -fsSLo /usr/local/bin/kubectl \
+        "https://dl.k8s.io/release/v1.29.0/bin/linux/amd64/kubectl" \
+    && chmod +x /usr/local/bin/kubectl
+
+COPY pyproject.toml ./
+RUN pip install --no-cache-dir "jax[cpu]" flax optax numpy pyyaml pydantic
+
+COPY kubernetes_aiops_evidence_graph_tpu/ ./kubernetes_aiops_evidence_graph_tpu/
+COPY native/ ./native/
+COPY tests/ ./tests/
+
+ENV PYTHONUNBUFFERED=1
+EXPOSE 8000
+
+# default: serve the platform (API + worker in one process); the compose
+# file overrides the command for the worker-only role
+CMD ["python", "-m", "kubernetes_aiops_evidence_graph_tpu.serve"]

@@ -12,6 +12,7 @@ from .metrics import (
     REMEDIATION_ATTEMPTS,
     WEBHOOK_LATENCY,
     WORKFLOW_STEP_DURATION,
+    WORKFLOW_STEPS,
     Counter,
     Gauge,
     Histogram,
@@ -37,6 +38,6 @@ __all__ = [
     "ALERTS_RECEIVED", "ALERTS_DEDUPLICATED", "INCIDENTS_CREATED",
     "INCIDENTS_RESOLVED", "REMEDIATION_ATTEMPTS", "HYPOTHESES_GENERATED",
     "EVIDENCE_COLLECTED", "WEBHOOK_LATENCY", "COLLECTOR_DURATION",
-    "RCA_DURATION", "WORKFLOW_STEP_DURATION",
+    "RCA_DURATION", "WORKFLOW_STEP_DURATION", "WORKFLOW_STEPS",
     "TRACER", "Tracer", "Span", "device_trace",
 ]

@@ -181,3 +181,7 @@ RCA_DURATION = REGISTRY.histogram(
     "aiops_rca_duration_seconds", "RCA scoring duration (new)")
 WORKFLOW_STEP_DURATION = REGISTRY.histogram(
     "aiops_workflow_step_duration_seconds", "Workflow step duration (new)")
+WORKFLOW_STEPS = REGISTRY.counter(
+    "aiops_workflow_steps_total",
+    "Workflow step outcomes by status (completed|failed) — feeds the "
+    "WorkflowFailures alert rule")

@@ -19,7 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Sequence
 
-from ..observability import WORKFLOW_STEP_DURATION, TRACER, get_logger
+from ..observability import WORKFLOW_STEP_DURATION, WORKFLOW_STEPS, TRACER, get_logger
 from ..storage import Database
 
 log = get_logger("workflow")
@@ -117,12 +117,14 @@ class WorkflowEngine:
                 json.dumps(result, default=str)  # journal-serializable check
                 WORKFLOW_STEP_DURATION.observe(
                     time.perf_counter() - t0, step=step.name)
+                WORKFLOW_STEPS.inc(step=step.name, status="completed")
                 self.db.journal_put(workflow_id, step.name, "completed",
                                     result, attempts=attempts)
                 return result
             except Exception as exc:
                 WORKFLOW_STEP_DURATION.observe(
                     time.perf_counter() - t0, step=step.name)
+                WORKFLOW_STEPS.inc(step=step.name, status="failed")
                 retryable = not isinstance(exc, step.retry.non_retryable)
                 log.warning("step_failed", workflow=workflow_id, step=step.name,
                             attempt=attempts, error=str(exc), retryable=retryable)

@@ -173,7 +173,8 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
         hyps = llm.enhance_hypotheses(ctx.incident, hyps, ctx.evidence_dicts)
     ctx.hypotheses = hyps
     RCA_DURATION.observe(_t.perf_counter() - t0, backend=backend_name)
-    HYPOTHESES_GENERATED.inc(len(hyps))
+    for h in hyps:
+        HYPOTHESES_GENERATED.inc(category=getattr(h.category, "value", str(h.category)))
     ctx.db.insert_hypotheses(hyps)
     return {
         "count": len(hyps),
