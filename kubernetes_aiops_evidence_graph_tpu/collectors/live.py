@@ -98,6 +98,25 @@ class LiveClusterBackend:
     def _k8s(self, path: str, params: dict[str, Any] | None = None) -> Any:
         return self._get(self.k8s_url, path, params, bearer=True)
 
+    def _k8s_write(self, method: str, path: str, payload: dict | None = None,
+                   content_type: str = "application/strategic-merge-patch+json"
+                   ) -> bool:
+        req = urllib.request.Request(
+            self.k8s_url + path, method=method,
+            data=json.dumps(payload).encode() if payload is not None else None)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        if payload is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                        context=self._ctx) as resp:
+                return 200 <= resp.status < 300
+        except Exception as exc:
+            self._log.error("k8s_write_failed", method=method, path=path,
+                            error=str(exc))
+            return False
+
     # -- K8s object mapping ----------------------------------------------
 
     @staticmethod
@@ -318,6 +337,59 @@ class LiveClusterBackend:
                 except (TypeError, ValueError):
                     continue
         return max(values) if values else None
+
+
+    # -- mutations (RemediationExecutor write surface; reference
+    # -- executor.py:86-307 via the kubernetes client) ---------------------
+
+    def delete_pod(self, namespace: str, name: str) -> bool:
+        """restart_pod = delete the pod (reference executor.py:86-134)."""
+        return self._k8s_write(
+            "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def restart_deployment(self, namespace: str, name: str) -> bool:
+        """Patch the restartedAt annotation (reference executor.py:136-175)."""
+        return self._k8s_write(
+            "PATCH", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}",
+            {"spec": {"template": {"metadata": {"annotations": {
+                "kubectl.kubernetes.io/restartedAt": utcnow().isoformat()}}}}})
+
+    def rollback_deployment(self, namespace: str, name: str) -> bool:
+        """Copy the previous ReplicaSet's pod template back onto the
+        deployment (reference executor.py:177-234, top-2 by revision)."""
+        data = self._k8s(f"/apis/apps/v1/namespaces/{namespace}/replicasets")
+        owned = []
+        for item in data.get("items", []):
+            meta = item["metadata"]
+            if any(r.get("kind") == "Deployment" and r.get("name") == name
+                   for r in meta.get("ownerReferences") or []):
+                owned.append((int((meta.get("annotations") or {}).get(
+                    "deployment.kubernetes.io/revision", 0)), item))
+        owned.sort(key=lambda t: t[0], reverse=True)
+        if len(owned) < 2:
+            self._log.error("rollback_no_previous_revision",
+                            namespace=namespace, deployment=name)
+            return False
+        prev_template = (owned[1][1].get("spec") or {}).get("template")
+        if not prev_template:
+            return False
+        return self._k8s_write(
+            "PATCH", f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}",
+            {"spec": {"template": prev_template}})
+
+    def scale_deployment(self, namespace: str, name: str, replicas: int) -> bool:
+        """Patch the scale subresource (reference executor.py:236-281)."""
+        return self._k8s_write(
+            "PATCH",
+            f"/apis/apps/v1/namespaces/{namespace}/deployments/{name}/scale",
+            {"spec": {"replicas": int(replicas)}},
+            content_type="application/merge-patch+json")
+
+    def cordon_node(self, name: str) -> bool:
+        """unschedulable=true (reference executor.py:283-307)."""
+        return self._k8s_write(
+            "PATCH", f"/api/v1/nodes/{name}",
+            {"spec": {"unschedulable": True}})
 
 
 def make_backend(settings: Settings | None = None, **overrides) -> Any:

@@ -85,18 +85,53 @@ def _cmd_run(args: argparse.Namespace) -> int:
     return 0
 
 
+def _live_injector(args: argparse.Namespace):
+    from ..collectors.live import LiveClusterBackend
+    from ..config import load_settings
+    from .live_faults import LiveFaultInjector
+
+    backend = LiveClusterBackend(load_settings(),
+                                 k8s_url=args.k8s_url or None)
+    return LiveFaultInjector(backend)
+
+
+def _cmd_create(args: argparse.Namespace) -> int:
+    created = _live_injector(args).create(args.scenario, args.namespace)
+    print(json.dumps({"created": created}))
+    return 0 if created else 1
+
+
+def _cmd_cleanup(args: argparse.Namespace) -> int:
+    removed = _live_injector(args).cleanup(args.namespace)
+    print(json.dumps({"removed": removed}))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="kaeg-sim", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
     sub.add_parser("list", help="list fault scenarios")
-    run = sub.add_parser("run", help="inject scenarios and run RCA")
+    run = sub.add_parser("run", help="inject scenarios and run RCA hermetically")
     run.add_argument("-s", "--scenario", action="append", required=True)
     run.add_argument("--pods", type=int, default=200)
     run.add_argument("--seed", type=int, default=0)
     run.add_argument("--backend", choices=("cpu", "tpu", "both"), default="both")
+    # live-cluster fault injection (reference incident_simulator.py:274-314)
+    create = sub.add_parser("create", help="apply a failing workload to a live cluster")
+    create.add_argument("-s", "--scenario", required=True,
+                        choices=("crashloop", "oom", "imagepull", "slowapp"))
+    create.add_argument("-n", "--namespace", default="default")
+    create.add_argument("--k8s-url", default="")
+    cleanup = sub.add_parser("cleanup", help="remove injected workloads (label simulator=kaeg-test)")
+    cleanup.add_argument("-n", "--namespace", default="default")
+    cleanup.add_argument("--k8s-url", default="")
     args = parser.parse_args(argv)
     if args.cmd == "list":
         return _cmd_list()
+    if args.cmd == "create":
+        return _cmd_create(args)
+    if args.cmd == "cleanup":
+        return _cmd_cleanup(args)
     return _cmd_run(args)
 
 

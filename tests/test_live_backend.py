@@ -75,9 +75,31 @@ LOKI = {"data": {"result": [{"values": [
 PROM = {"data": {"result": [{"value": ["1753790400", "93.5"]}]}}
 
 
+WRITES: list[tuple[str, str, dict]] = []
+
+
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
+
+    def _record(self, method):
+        length = int(self.headers.get("Content-Length") or 0)
+        body = json.loads(self.rfile.read(length)) if length else {}
+        WRITES.append((method, urlparse(self.path).path, body))
+        out = b"{}"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def do_DELETE(self):
+        self._record("DELETE")
+
+    def do_PATCH(self):
+        self._record("PATCH")
+
+    def do_POST(self):
+        self._record("POST")
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -145,6 +167,49 @@ def test_loki_and_prometheus(backend):
     v = backend.query_metric("payments", "checkout", "memory_usage_pct")
     assert v == pytest.approx(93.5)
     assert backend.query_metric("payments", "checkout", "nonexistent_query") is None
+
+
+def test_k8s_write_surface(backend):
+    WRITES.clear()
+    assert backend.delete_pod("payments", "checkout-abc12-x1")
+    assert backend.restart_deployment("payments", "checkout")
+    assert backend.rollback_deployment("payments", "checkout")
+    assert backend.scale_deployment("payments", "checkout", 5)
+    assert backend.cordon_node("node-1")
+
+    methods = [(m, p) for m, p, _ in WRITES]
+    assert ("DELETE", "/api/v1/namespaces/payments/pods/checkout-abc12-x1") in methods
+    restart = next(b for m, p, b in WRITES
+                   if p.endswith("/deployments/checkout") and
+                   "annotations" in str(b))
+    assert "restartedAt" in json.dumps(restart)
+    rollback = [b for m, p, b in WRITES if p.endswith("/deployments/checkout")]
+    # rollback patch carries the previous revision's pod template image
+    assert any("reg/app:v3" in json.dumps(b) for b in rollback)
+    scale = next(b for m, p, b in WRITES if p.endswith("/scale"))
+    assert scale == {"spec": {"replicas": 5}}
+    cordon = next(b for m, p, b in WRITES if p.endswith("/nodes/node-1"))
+    assert cordon == {"spec": {"unschedulable": True}}
+
+
+def test_live_fault_injector(backend):
+    from kubernetes_aiops_evidence_graph_tpu.simulator.live_faults import (
+        LiveFaultInjector, manifests)
+
+    for scenario in ("crashloop", "oom", "imagepull", "slowapp"):
+        ms = manifests(scenario, "default")
+        assert all(m["metadata"]["labels"]["simulator"] == "kaeg-test" for m in ms)
+    assert manifests("slowapp", "default")[1]["kind"] == "Service"
+
+    WRITES.clear()
+    inj = LiveFaultInjector(backend)
+    created = inj.create("crashloop", namespace="payments")
+    assert created == ["Deployment/kaeg-sim-crashloop"]
+    # idempotency: DELETE precedes POST
+    assert [m for m, _p, _b in WRITES] == ["DELETE", "POST"]
+    assert WRITES[1][1] == "/apis/apps/v1/namespaces/payments/deployments"
+    posted = WRITES[1][2]
+    assert posted["spec"]["template"]["spec"]["containers"][0]["image"].startswith("busybox")
 
 
 def test_collectors_run_through_live_backend(backend):
