@@ -3,17 +3,28 @@
 The distributed design (SURVEY.md §2.4, scaling-book recipe: pick a mesh,
 annotate shardings, let XLA place collectives):
 
-* node embeddings are computed shard-locally on the ``graph`` axis, then
-  **all-gathered over 'graph'** once per layer so every shard can read the
-  source side of its incoming edges — the halo exchange of our node-
-  parallel (sequence-parallel analog) dimension, riding ICI;
+* node embeddings are computed shard-locally on the ``graph`` axis; each
+  layer then performs a **halo exchange** so every shard can read the
+  source side of its incoming edges — the node-parallel (sequence/context-
+  parallel analog) dimension, riding ICI. Two interchangeable strategies:
+
+  - ``halo="allgather"``: one all-gather of the full [N, H] embedding
+    matrix per layer. Simple, minimum latency at small N.
+  - ``halo="ring"``: the ring-attention analog — D-1 ``ppermute`` steps
+    stream neighbor shards' [N/D, H] blocks around the ring; each step
+    accumulates messages from edges whose source lives in the block in
+    flight, overlapping compute with communication and never
+    materializing more than one remote block (O(N/D) memory vs O(N)).
+    This is what makes 50k+-node graphs fit when H or D grows.
+
 * each graph shard scatter-adds messages only into its own node range
   (edges were host-partitioned by destination, partition.py);
-* incidents are read out on the ``dp`` axis from the gathered embeddings;
-  the loss is a masked mean **psum'd over both axes**;
+* incidents are read out on the ``dp`` axis (ring mode streams the
+  readout too); the loss is a masked mean **psum'd over both axes**;
 * `jax.grad` differentiates straight through shard_map, so gradient
-  collectives (psum of the all-gather transpose = reduce-scatter) are
-  inserted by XLA automatically; parameters stay replicated.
+  collectives (psum of the all-gather transpose = reduce-scatter; the
+  ppermute transpose = counter-rotation) are inserted by XLA
+  automatically; parameters stay replicated.
 """
 from __future__ import annotations
 
@@ -25,8 +36,63 @@ from jax import shard_map
 from ..rca import gnn
 
 
-def _sharded_loss(mesh: Mesh):
+def _ring_perm(d: int) -> list[tuple[int, int]]:
+    return [(i, (i + 1) % d) for i in range(d)]
+
+
+def _ring_messages(h_local, esrc, emask, edst_local, d: int):
+    """Ring halo exchange: accumulate src-side messages into local dst rows.
+
+    Step r holds shard ((my - r) mod d)'s embedding block; edges whose
+    global src index falls in that shard's range consume it, then the block
+    rotates one hop around the ring (ppermute over 'graph')."""
+    nps = h_local.shape[0]
+    my = jax.lax.axis_index("graph")
+
+    def body(r, carry):
+        h_block, agg = carry
+        src_shard = jnp.mod(my - r, d)
+        lo = src_shard * nps
+        in_block = ((esrc >= lo) & (esrc < lo + nps)).astype(h_block.dtype)
+        local_src = jnp.clip(esrc - lo, 0, nps - 1)
+        msg = h_block[local_src] * (emask * in_block)[:, None]
+        agg = agg.at[edst_local].add(msg)
+        h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(d))
+        return h_block, agg
+
+    _, agg = jax.lax.fori_loop(
+        0, d, body, (h_local, jnp.zeros_like(h_local)))
+    return agg
+
+
+def _ring_readout(h_local, inc_nodes, d: int):
+    """Stream incident-node embeddings out of the ring (no all-gather)."""
+    nps = h_local.shape[0]
+    my = jax.lax.axis_index("graph")
+
+    def body(r, carry):
+        h_block, emb = carry
+        src_shard = jnp.mod(my - r, d)
+        lo = src_shard * nps
+        in_block = ((inc_nodes >= lo) & (inc_nodes < lo + nps)
+                    ).astype(h_block.dtype)
+        local = jnp.clip(inc_nodes - lo, 0, nps - 1)
+        emb = emb + h_block[local] * in_block[:, None]
+        h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(d))
+        return h_block, emb
+
+    _, emb = jax.lax.fori_loop(
+        0, d, body,
+        (h_local, jnp.zeros((inc_nodes.shape[0], h_local.shape[1]),
+                            h_local.dtype)))
+    return emb
+
+
+def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
     """Build the shard_map'd loss over local shards."""
+    if halo not in ("allgather", "ring"):
+        raise ValueError(f"halo must be allgather|ring, got {halo!r}")
+    graph_size = mesh.shape["graph"]
 
     def local_loss(params, feats, kind, nmask, esrc, edst_local, emask,
                    inc_nodes, inc_mask, labels):
@@ -46,15 +112,24 @@ def _sharded_loss(mesh: Mesh):
 
         for layer in params["layers"]:
             # halo exchange: every shard needs src embeddings of its in-edges
-            h_full = jax.lax.all_gather(h_local, "graph", tiled=True)   # [N, H]
-            msg = h_full[esrc] * emask[:, None]
-            agg = jnp.zeros_like(h_local).at[edst_local].add(msg) * inv_deg[:, None]
+            if halo == "ring":
+                agg = _ring_messages(h_local, esrc, emask, edst_local,
+                                     graph_size)
+            else:
+                h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
+                msg = h_full[esrc] * emask[:, None]
+                agg = jnp.zeros_like(h_local).at[edst_local].add(msg)
+            agg = agg * inv_deg[:, None]
             h_local = jax.nn.relu(
                 h_local @ layer["w_self"] + agg @ layer["w_msg"] + layer["b"]
             ) + h_local
 
-        h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
-        logits = h_full[inc_nodes] @ params["head_w"] + params["head_b"]   # [B/D, C]
+        if halo == "ring":
+            emb = _ring_readout(h_local, inc_nodes, graph_size)
+        else:
+            h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
+            emb = h_full[inc_nodes]
+        logits = emb @ params["head_w"] + params["head_b"]         # [B/D, C]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
         # incidents are dp-sharded; graph shards all compute the same readout
@@ -76,9 +151,9 @@ def _sharded_loss(mesh: Mesh):
     )
 
 
-def make_sharded_train_step(mesh: Mesh, tx):
+def make_sharded_train_step(mesh: Mesh, tx, halo: str = "allgather"):
     """jitted (params, opt_state, part: PartitionedGraph arrays) -> step."""
-    sharded_loss = _sharded_loss(mesh)
+    sharded_loss = _sharded_loss(mesh, halo=halo)
 
     def loss_scalar(params, *arrs):
         return sharded_loss(params, *arrs).mean()
