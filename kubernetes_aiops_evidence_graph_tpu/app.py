@@ -117,10 +117,20 @@ class AiopsApp:
 
 
 def main() -> None:  # pragma: no cover - manual entrypoint
-    """Serve against a simulated cluster (hermetic demo mode)."""
-    from .simulator import generate_cluster
+    """Serve the platform. KAEG_CLUSTER_BACKEND selects the evidence source
+    (fake = hermetic demo cluster, kubernetes = live K8s/Prometheus/Loki);
+    KAEG_COORDINATOR/_NUM_PROCESSES/_PROCESS_ID join a multi-host process
+    group (parallel/multihost.py) before any device use."""
+    from .parallel import init_distributed
+    init_distributed()
     settings = get_settings()
-    app = AiopsApp(generate_cluster(num_pods=200, seed=0), settings)
+    if settings.cluster_backend == "kubernetes":
+        from .collectors.live import LiveClusterBackend
+        cluster: Any = LiveClusterBackend(settings)
+    else:
+        from .simulator import generate_cluster
+        cluster = generate_cluster(num_pods=200, seed=0)
+    app = AiopsApp(cluster, settings)
     port = app.start()
     print(f"kaeg-tpu serving on :{port} (Ctrl-C to stop)")
     try:

@@ -1,0 +1,80 @@
+"""Multi-host bootstrap — the DCN half of the communication backend.
+
+The reference scales horizontally by adding Temporal worker containers
+against a shared server (worker.py:43-61, docker-compose.yml). The TPU
+equivalent is a *SPMD process group*: every host runs this same program,
+`jax.distributed.initialize` wires the controller, and a mesh whose outer
+axis spans hosts makes XLA route that axis's collectives over DCN while
+inner axes stay on ICI (scaling-book recipe; SURVEY.md §2.4/§5
+"Distributed communication backend").
+
+Design rule encoded here: put ``dp`` (incidents) on the host axis — DP
+gradients/score merges are one psum per step and tolerate DCN latency —
+and keep ``graph`` (per-layer halo exchanges) inside a slice on ICI.
+
+Usage (same command on every host, env-configured):
+
+    KAEG_COORDINATOR=host0:9876 KAEG_NUM_PROCESSES=4 KAEG_PROCESS_ID=$i \
+        python -m kubernetes_aiops_evidence_graph_tpu.serve
+
+On single-host (or under the driver's virtual CPU mesh) everything here
+degrades to a no-op and `make_multihost_mesh` equals `make_mesh`.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+
+def init_distributed() -> bool:
+    """Initialize the JAX process group from KAEG_* env, if configured.
+
+    Returns True when running multi-process after the call. TPU pod slices
+    auto-discover (initialize() with no args); explicit env wins so the
+    same entrypoint also works on CPU/GPU fleets."""
+    coordinator = os.environ.get("KAEG_COORDINATOR", "")
+    num = int(os.environ.get("KAEG_NUM_PROCESSES", "0") or 0)
+    pid = int(os.environ.get("KAEG_PROCESS_ID", "-1") or -1)
+    if coordinator and num > 1 and pid >= 0:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num,
+            process_id=pid,
+        )
+        return True
+    if os.environ.get("KAEG_AUTO_DISTRIBUTED", "") == "1":
+        jax.distributed.initialize()  # TPU pod auto-discovery
+        return jax.process_count() > 1
+    return False
+
+
+def make_multihost_mesh(graph_per_host: int | None = None) -> Mesh:
+    """(dp × graph) mesh with dp spanning hosts (DCN) and graph local (ICI).
+
+    Each host contributes its local devices to the graph axis; the dp axis
+    length equals the host count × any leftover local factor. With one
+    process this is exactly `make_mesh()`."""
+    if jax.process_count() == 1:
+        return make_mesh()
+    local = jax.local_device_count()
+    graph = graph_per_host or local
+    if local % graph != 0:
+        raise ValueError(
+            f"graph_per_host={graph} must divide local devices {local}")
+    # global device array ordered host-major: hosts × local -> (dp, graph)
+    devices = np.asarray(jax.devices())  # sorted by (process_index, local id)
+    dp = devices.size // graph
+    return Mesh(devices.reshape(dp, graph), axis_names=("dp", "graph"))
+
+
+def host_local_incident_slice(num_incidents: int) -> slice:
+    """Which incident rows this host feeds (dp is the host axis): contiguous
+    block partitioning with the tail on the last host."""
+    n, k = jax.process_count(), jax.process_index()
+    per = -(-num_incidents // n)  # ceil
+    return slice(k * per, min((k + 1) * per, num_incidents))

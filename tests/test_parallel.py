@@ -109,6 +109,17 @@ def test_ring_halo_matches_allgather():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_multihost_helpers_single_process():
+    """Single-process degradation: mesh == make_mesh, slice == everything,
+    no distributed init."""
+    from kubernetes_aiops_evidence_graph_tpu.parallel import (
+        host_local_incident_slice, init_distributed, make_multihost_mesh)
+    assert init_distributed() is False           # no KAEG_* env configured
+    mesh = make_multihost_mesh()
+    assert mesh.devices.size == 8 and mesh.axis_names == ("dp", "graph")
+    assert host_local_incident_slice(500) == slice(0, 500)
+
+
 def test_ring_train_step_decreases_loss():
     snapshot, labels = _labeled_snapshot()
     mesh = make_mesh(dp=2, graph=4)
