@@ -1,0 +1,144 @@
+"""Slack approval broker + Jira ticketing (integrations/) — hermetic.
+
+Parity targets: reference SlackClient Block Kit approval flow
+(slack_client.py:21-113) — but with a REAL resolution path (the reference
+always returns pending, SURVEY.md §3.6 item 8) — and JiraClient Bug
+creation with the severity→priority map (slack_client.py:125-206).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from uuid import uuid4
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.integrations.jira import JiraClient
+from kubernetes_aiops_evidence_graph_tpu.integrations.slack import (
+    ApprovalBroker, SlackClient,
+)
+from kubernetes_aiops_evidence_graph_tpu.models import (
+    ActionType, ApprovalRequest, Hypothesis, HypothesisCategory, Incident,
+    Severity, ActionRisk,
+)
+
+
+def hermetic_settings(**kw):
+    """Settings with all outbound transports forced off, regardless of any
+    ambient KAEG_* env vars on the host."""
+    kw.setdefault("slack_webhook_url", "")
+    kw.setdefault("jira_url", "")
+    return load_settings(**kw)
+
+
+def make_request(**kw) -> ApprovalRequest:
+    defaults = dict(
+        action_id=uuid4(), incident_id=uuid4(),
+        incident_title="CrashLoopBackOff in checkout",
+        hypothesis_summary="Recent deployment caused application crash",
+        action_type=ActionType.ROLLBACK_DEPLOYMENT,
+        target_resource="checkout", target_namespace="shop",
+        risk_level=ActionRisk.HIGH, blast_radius_score=42.0)
+    defaults.update(kw)
+    return ApprovalRequest(**defaults)
+
+
+class TestApprovalBroker:
+    def test_register_resolve_wait_roundtrip(self):
+        broker = ApprovalBroker()
+        req = make_request()
+        broker.register(req)
+        assert [p.action_id for p in broker.pending()] == [req.action_id]
+        assert broker.resolve(str(req.action_id), approved=True,
+                              responder="alice", notes="lgtm")
+        resp = broker.wait(str(req.action_id), timeout_s=0.1)
+        assert resp is not None and resp.approved
+        assert resp.responder == "alice" and resp.notes == "lgtm"
+        assert broker.pending() == []  # consumed
+
+    def test_wait_times_out_as_none(self):
+        broker = ApprovalBroker()
+        req = make_request()
+        broker.register(req)
+        assert broker.wait(str(req.action_id), timeout_s=0.01) is None
+
+    def test_resolve_unknown_action_is_false(self):
+        assert not ApprovalBroker().resolve("nope", approved=True)
+
+    def test_concurrent_resolution_unblocks_waiter(self):
+        broker = ApprovalBroker()
+        req = make_request()
+        broker.register(req)
+        timer = threading.Timer(
+            0.05, broker.resolve, args=(str(req.action_id), False))
+        timer.start()
+        resp = broker.wait(str(req.action_id), timeout_s=5.0)
+        timer.join()
+        assert resp is not None and not resp.approved
+
+
+class TestSlackClient:
+    def test_unconfigured_posts_to_outbox(self):
+        client = SlackClient(hermetic_settings(), broker=ApprovalBroker())
+        assert not client.configured
+        assert client.notify("hello") is False
+        assert client.outbox[-1]["text"] == "hello"
+
+    def test_request_approval_notifies_and_blocks_until_resolved(self):
+        broker = ApprovalBroker()
+        client = SlackClient(hermetic_settings(), broker=broker)
+        req = make_request()
+
+        def resolver():  # wait until request_approval has registered it
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not broker.pending():
+                time.sleep(0.002)
+            broker.resolve(str(req.action_id), True)
+
+        t = threading.Thread(target=resolver)
+        t.start()
+        resp = client.request_approval(req, timeout_s=5.0)
+        t.join()
+        assert resp is not None and resp.approved
+        # the notification carried the resolution endpoint + Block Kit section
+        msg = client.outbox[-1]
+        assert f"/api/v1/approvals/{req.action_id}" in msg["text"]
+        assert msg["blocks"][0]["type"] == "section"
+        assert "CrashLoopBackOff in checkout" in msg["blocks"][0]["text"]["text"]
+
+    def test_request_approval_timeout_returns_none(self):
+        client = SlackClient(hermetic_settings(), broker=ApprovalBroker())
+        assert client.request_approval(make_request(), timeout_s=0.01) is None
+
+
+class TestJiraClient:
+    def _incident(self, severity: Severity) -> Incident:
+        return Incident(title="OOMKilled in api", fingerprint=f"fp-{severity.value}",
+                        severity=severity, namespace="prod-api", service="api")
+
+    def test_unconfigured_queues_payload(self):
+        client = JiraClient(hermetic_settings())
+        inc = self._incident(Severity.CRITICAL)
+        hyp = Hypothesis(
+            incident_id=inc.id, category=HypothesisCategory.RESOURCE_EXHAUSTION,
+            title="Container killed by OOM", description="memory limit too low",
+            confidence=0.95, recommended_actions=["scale_deployment"])
+        out = client.create_incident_ticket(inc, hyp)
+        assert out == {"created": False, "queued": True, "payload": client.outbox[-1]}
+        fields = out["payload"]["fields"]
+        assert fields["project"]["key"] == "OPS"
+        assert fields["issuetype"]["name"] == "Bug"
+        assert fields["summary"] == "[AIOps] OOMKilled in api"
+        assert fields["priority"]["name"] == "Highest"
+        assert "severity-critical" in fields["labels"]
+        assert "Container killed by OOM" in fields["description"]
+        assert "- scale_deployment" in fields["description"]
+
+    def test_severity_priority_map(self):
+        # slack_client.py:196-204 severity→priority
+        expected = {Severity.CRITICAL: "Highest", Severity.HIGH: "High",
+                    Severity.MEDIUM: "Medium", Severity.LOW: "Low",
+                    Severity.INFO: "Lowest"}
+        client = JiraClient(hermetic_settings())
+        for sev, prio in expected.items():
+            out = client.create_incident_ticket(self._incident(sev))
+            assert out["payload"]["fields"]["priority"]["name"] == prio

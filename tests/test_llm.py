@@ -1,0 +1,142 @@
+"""LLM hypothesis enrichment (rca/llm.py) — hermetic provider tests.
+
+Parity target: reference LLMSummarizer (llm_summarizer.py:22-190): top-3
+enhancement, brace-scan JSON extraction, provider response parsing, and
+silent fallback to rules-only hypotheses on any failure
+(activities.py:144-152). All transports are stubbed; no network.
+"""
+from __future__ import annotations
+
+from uuid import uuid4
+
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.models import (
+    Hypothesis, HypothesisCategory, HypothesisSource, Incident, Severity,
+)
+from kubernetes_aiops_evidence_graph_tpu.rca.llm import LLMSummarizer, _extract_json
+
+
+def make_incident() -> Incident:
+    return Incident(
+        title="CrashLoopBackOff in checkout", fingerprint="fp-llm",
+        severity=Severity.HIGH, namespace="shop", service="checkout")
+
+
+def make_hypothesis(incident: Incident) -> Hypothesis:
+    return Hypothesis(
+        incident_id=incident.id, category=HypothesisCategory.BAD_DEPLOYMENT,
+        title="Recent deployment caused application crash",
+        description="base description", confidence=0.9,
+        recommended_actions=["rollback_deployment"], rule_id="crashloop_recent_deploy")
+
+
+class TestExtractJson:
+    def test_plain_object(self):
+        assert _extract_json('{"a": 1}') == {"a": 1}
+
+    def test_embedded_in_prose_with_nested_braces(self):
+        text = 'Sure! Here is the JSON:\n{"a": {"b": 2}, "c": [1]}\nHope it helps.'
+        assert _extract_json(text) == {"a": {"b": 2}, "c": [1]}
+
+    def test_no_braces(self):
+        assert _extract_json("no json here") is None
+
+    def test_unbalanced_or_invalid(self):
+        assert _extract_json('{"a": 1') is None
+        assert _extract_json("{not json}") is None
+
+
+class TestEnhance:
+    ENHANCEMENT = (
+        'prefix {"reasoning": "deploy 12 min before crash", '
+        '"additional_steps": ["diff the images", "rollback_deployment"], '
+        '"alternatives": "could be config", '
+        '"enhanced_description": "richer"} suffix')
+
+    def _summarizer(self, reply: str | Exception) -> LLMSummarizer:
+        s = LLMSummarizer(load_settings(llm_provider="openai", llm_api_key="k"))
+
+        def fake_post(url, payload, headers):
+            if isinstance(reply, Exception):
+                raise reply
+            return {"choices": [{"message": {"content": reply}}]}
+
+        s._post_json = fake_post
+        return s
+
+    def test_enhancement_applied_and_marked_hybrid(self):
+        inc = make_incident()
+        h = make_hypothesis(inc)
+        out = self._summarizer(self.ENHANCEMENT).enhance_hypotheses(inc, [h], [])
+        assert out[0].reasoning == "deploy 12 min before crash"
+        assert out[0].description == "richer"
+        assert out[0].why_not_notes == "could be config"
+        # de-dups steps already present, appends the new one
+        assert out[0].recommended_actions == ["rollback_deployment", "diff the images"]
+        assert out[0].generated_by is HypothesisSource.HYBRID
+
+    def test_failure_falls_back_silently(self):
+        inc = make_incident()
+        h = make_hypothesis(inc)
+        out = self._summarizer(RuntimeError("boom")).enhance_hypotheses(inc, [h], [])
+        assert out[0].description == "base description"
+        assert out[0].generated_by is HypothesisSource.RULES_ENGINE
+
+    def test_unparseable_reply_keeps_original(self):
+        inc = make_incident()
+        h = make_hypothesis(inc)
+        out = self._summarizer("I cannot answer in JSON").enhance_hypotheses(inc, [h], [])
+        assert out[0].description == "base description"
+
+    def test_only_top_n_enhanced(self):
+        inc = make_incident()
+        hs = [make_hypothesis(inc) for _ in range(5)]
+        out = self._summarizer(self.ENHANCEMENT).enhance_hypotheses(inc, hs, [], top_n=3)
+        assert [h.generated_by for h in out[:3]] == [HypothesisSource.HYBRID] * 3
+        assert [h.generated_by for h in out[3:]] == [HypothesisSource.RULES_ENGINE] * 2
+
+    def test_disabled_provider_is_identity(self):
+        inc = make_incident()
+        h = make_hypothesis(inc)
+        s = LLMSummarizer(load_settings(llm_provider="none"))
+        assert not s.enabled
+        assert s.enhance_hypotheses(inc, [h], []) == [h]
+
+
+class TestProviderParsing:
+    """Each provider's response-shape parser (llm_summarizer.py:92-190)."""
+
+    def _with_reply(self, provider: str, body: dict) -> str | None:
+        s = LLMSummarizer(load_settings(llm_provider=provider, llm_api_key="k"))
+        s._post_json = lambda url, payload, headers: body
+        return s._complete("prompt")
+
+    def test_gemini(self):
+        body = {"candidates": [{"content": {"parts": [{"text": "he"}, {"text": "llo"}]}}]}
+        assert self._with_reply("gemini", body) == "hello"
+        assert self._with_reply("gemini", {"candidates": []}) is None
+
+    def test_openai(self):
+        body = {"choices": [{"message": {"content": "hi"}}]}
+        assert self._with_reply("openai", body) == "hi"
+        assert self._with_reply("openai", {"choices": []}) is None
+
+    def test_ollama(self):
+        assert self._with_reply("ollama", {"response": "yo"}) == "yo"
+
+    def test_unknown_provider_raises(self):
+        s = LLMSummarizer(load_settings(llm_provider="watsonx"))
+        with pytest.raises(ValueError):
+            s._complete("prompt")
+
+    def test_prompt_contains_incident_and_evidence(self):
+        inc = make_incident()
+        h = make_hypothesis(inc)
+        s = LLMSummarizer(load_settings(llm_provider="openai", llm_api_key="k"))
+        evidence = [{"evidence_type": "pod_status", "entity_name": "pod-1",
+                     "data": {"waiting_reason": "CrashLoopBackOff"}}]
+        prompt = s._build_prompt(inc, h, evidence)
+        assert "CrashLoopBackOff in checkout" in prompt
+        assert "- pod_status: pod-1 (CrashLoopBackOff)" in prompt
