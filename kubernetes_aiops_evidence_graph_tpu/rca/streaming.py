@@ -68,7 +68,7 @@ class StreamingScorer:
         # and forcing them costs a ~70 ms sync per structural flush on the
         # dev tunnel
         return (
-            jnp.asarray(b.ev_rows), jnp.asarray(b.ev_dst), jnp.asarray(b.ev_mask),
+            jnp.asarray(b.ev_idx), jnp.asarray(b.ev_cnt),
             jnp.asarray(b.pair_ids), jnp.asarray(b.pair_pod), jnp.asarray(b.pair_mask),
             jnp.asarray(b.pair_rows), jnp.asarray(b.pair_rows_mask),
         )

@@ -39,3 +39,32 @@ def test_propagate_labels_conserves_and_spreads():
     assert out[1] > out[2] > out[3] >= 0  # decays with distance
     assert out[4] == 0.0                  # isolated node untouched
     assert out[0] > 0.1                   # source retains mass
+
+
+def test_wide_evidence_fold_uses_chunked_path():
+    """One evidence-heavy incident (W > _FOLD_CHUNK) must fold correctly
+    through the lax.scan chunk path and match a direct numpy fold."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.schema import DIM
+    from kubernetes_aiops_evidence_graph_tpu.rca import tpu_backend as tb
+
+    rng = np.random.default_rng(0)
+    pn, pi = 64, 8
+    features = rng.random((pn, DIM)).astype(np.float32)
+    width = 2 * tb._FOLD_CHUNK          # forces the scan branch
+    ev_idx = np.zeros((pi, width), np.int32)
+    ev_cnt = np.zeros(pi, np.int32)
+    ev_cnt[0] = width - 3               # skewed row, beyond one chunk
+    ev_cnt[1] = 5
+    ev_idx[0, :ev_cnt[0]] = rng.integers(0, pn, ev_cnt[0])
+    ev_idx[1, :ev_cnt[1]] = rng.integers(0, pn, ev_cnt[1])
+
+    counts, _ = tb._aggregate(
+        jnp.asarray(features), jnp.asarray(ev_idx), jnp.asarray(ev_cnt),
+        jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.float32),
+        jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.float32),
+        padded_incidents=pi, num_pairs=4)
+
+    expected = np.zeros((pi, DIM), np.float32)
+    for r in range(pi):
+        expected[r] = features[ev_idx[r, :ev_cnt[r]]].sum(axis=0)
+    np.testing.assert_allclose(np.asarray(counts), expected, rtol=1e-5, atol=1e-5)
