@@ -56,8 +56,9 @@ class Settings:
     kubeconfig: str = ""
 
     # --- rca ---
-    rca_backend: str = "tpu"                       # cpu|tpu (plugin seam, BASELINE.json north star)
+    rca_backend: str = "tpu"                       # cpu|tpu|gnn (plugin seam, BASELINE.json north star)
     rca_propagation_hops: int = 3                  # graph depth analog (neo4j.py:174 maxLevel=3)
+    gnn_checkpoint: str = ""                       # orbax dir for rca_backend=gnn
     llm_provider: str = "none"                     # none|gemini|openai|ollama
     llm_api_key: str = ""
     llm_model: str = ""

@@ -20,9 +20,12 @@ def get_backend(name: str):
     if name == "tpu":
         from .tpu_backend import TpuRcaBackend
         _BACKEND_CLASSES.setdefault("tpu", TpuRcaBackend)
+    elif name == "gnn":
+        from .gnn_backend import GnnRcaBackend
+        _BACKEND_CLASSES.setdefault("gnn", GnnRcaBackend)
     cls = _BACKEND_CLASSES.get(name)
     if cls is None:
-        raise KeyError(f"unknown rca backend {name!r}; available: cpu, tpu")
+        raise KeyError(f"unknown rca backend {name!r}; available: cpu, tpu, gnn")
     return _INSTANCES.setdefault(name, cls())
 
 

@@ -18,11 +18,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ..graph.schema import DIM
+from ..graph.schema import DIM, EntityKind
 from .ruleset import NUM_RULES
 
 NUM_CLASSES = NUM_RULES + 1   # + unknown
-NUM_KINDS = 11                # graph.schema.EntityKind members
+NUM_KINDS = len(EntityKind)   # embedding rows track the schema
 
 Params = dict[str, Any]
 

@@ -1,0 +1,95 @@
+"""Learned RCA backend — rca_backend="gnn".
+
+Third backend behind the plugin seam (alongside the CPU oracle and the
+TPU rules pass): scores every incident in a GraphSnapshot with the trained
+GNN (rca/gnn.py), returning the same raw-dict / RCAResult surface as
+TpuRcaBackend so the workflow and API are backend-agnostic. Parameters come
+from an orbax checkpoint (settings.gnn_checkpoint, written by rca/train.py)
+or are injected directly.
+"""
+from __future__ import annotations
+
+from uuid import uuid4
+
+import numpy as np
+
+import jax
+
+from ..models import Hypothesis, HypothesisSource, RCAResult
+from . import gnn
+from .ruleset import NUM_RULES, RULES, UNKNOWN_CONFIDENCE
+from .tpu_backend import _incident_uuid
+
+
+class GnnRcaBackend:
+    name = "gnn"
+
+    def __init__(self, params: gnn.Params | None = None) -> None:
+        if params is None:
+            from ..config import get_settings
+            path = get_settings().gnn_checkpoint
+            if not path:
+                raise ValueError(
+                    "rca_backend=gnn needs trained parameters: set "
+                    "KAEG_GNN_CHECKPOINT (written by rca/train.py) or pass "
+                    "params=")
+            from .train import load_checkpoint
+            params = load_checkpoint(path)["params"]
+        self.params = params
+        self._forward = jax.jit(gnn.forward)
+
+    def score_snapshot(self, snapshot) -> dict:
+        """Same keys as TpuRcaBackend.score_snapshot where meaningful."""
+        b = gnn.snapshot_batch(snapshot)
+        logits = self._forward(
+            self.params, b["features"], b["node_kind"], b["node_mask"],
+            b["edge_src"], b["edge_dst"], b["edge_mask"], b["incident_nodes"])
+        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        n = snapshot.num_incidents
+        pred = probs.argmax(axis=-1)
+        return {
+            "incident_ids": snapshot.incident_ids,
+            "probs": probs[:n],
+            "top_rule_index": pred[:n],                      # NUM_RULES = unknown
+            "any_match": (pred != NUM_RULES)[:n],
+            "top_confidence": probs.max(axis=-1)[:n],
+        }
+
+    def results(self, snapshot, raw: dict | None = None,
+                top_k: int = 3) -> list[RCAResult]:
+        raw = raw or self.score_snapshot(snapshot)
+        out: list[RCAResult] = []
+        for i, inc_id in enumerate(raw["incident_ids"]):
+            uid = _incident_uuid(inc_id)
+            order = np.argsort(raw["probs"][i])[::-1][:top_k]
+            hyps: list[Hypothesis] = []
+            # argmax == unknown  ⇒  any_match is False: the incident gets the
+            # unknown hypothesis, not a low-probability rule promoted to top-1
+            if int(order[0]) != NUM_RULES:
+                ranked = [c for c in order
+                          if c != NUM_RULES and raw["probs"][i][c] > 0.0]
+                for rank, cls in enumerate(ranked, start=1):
+                    conf = float(raw["probs"][i][cls])
+                    rule = RULES[int(cls)]
+                    hyps.append(Hypothesis(
+                        id=uuid4(), incident_id=uid, category=rule.category,
+                        title=rule.name, description=rule.description,
+                        confidence=min(conf, 0.99), final_score=conf, rank=rank,
+                        recommended_actions=rule.recommended_actions,
+                        rule_id=rule.id, backend="gnn",
+                        generated_by=HypothesisSource.GNN,
+                    ))
+            if not hyps:
+                from .cpu_backend import _unknown_hypothesis
+                from .signals import Signals
+                h = _unknown_hypothesis(uid, Signals())
+                h.backend = "gnn"
+                h.generated_by = HypothesisSource.GNN
+                h.confidence = UNKNOWN_CONFIDENCE
+                hyps = [h]
+            out.append(RCAResult(
+                incident_id=uid, hypotheses=hyps, top_hypothesis=hyps[0],
+                rules_matched=[h.rule_id for h in hyps if h.rule_id != "unknown"],
+                backend="gnn",
+            ))
+        return out

@@ -1,0 +1,171 @@
+"""GNN trainer — labeled episodes from the simulator, eval vs the oracle.
+
+The reference has no trainable model (SURVEY.md §2.4: no model anywhere);
+this is the framework's own addition on top of capability parity: the
+KGroot-style GNN scorer (rca/gnn.py) trained on fault-injection episodes
+whose labels are the scenarios' expected diagnosis rules — the same signal
+the deterministic ruleset encodes, so eval accuracy is directly comparable
+to the rules oracle.
+
+Usage (also ``python -m kubernetes_aiops_evidence_graph_tpu.rca.train``):
+
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import train
+    result = train(episodes=8, steps=200)   # -> params, metrics history
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+import numpy as np
+
+import jax
+
+from .ruleset import RULE_INDEX
+from . import gnn
+
+
+def make_episode(num_pods: int, num_incidents: int, seed: int) -> dict:
+    """One labeled training episode: a fresh simulated cluster with
+    ``num_incidents`` injected scenarios → snapshot batch + labels."""
+    from ..collectors import collect_all, default_collectors
+    from ..config import load_settings
+    from ..graph import GraphBuilder, build_snapshot
+    from ..graph.topology_sync import sync_topology
+    from ..simulator import SCENARIOS, generate_cluster, inject
+
+    settings = load_settings(
+        node_bucket_sizes=(256, 512, 1024, 4096),
+        edge_bucket_sizes=(1024, 4096, 16384),
+        incident_bucket_sizes=(8, 32),
+    )
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    deploy_keys = sorted(cluster.deployments)
+    names = sorted(SCENARIOS)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    labels = []
+    for i in range(num_incidents):
+        name = names[(seed + i) % len(names)]
+        inc = inject(cluster, name, deploy_keys[(i * 5) % len(deploy_keys)], rng)
+        builder.ingest(inc, collect_all(inc, default_collectors(cluster, settings),
+                                        parallel=False))
+        labels.append(RULE_INDEX[SCENARIOS[name].expected_rule])
+    snap = build_snapshot(builder.store, settings, now_s=cluster.now.timestamp())
+    return gnn.snapshot_batch(snap, np.asarray(labels, dtype=np.int32))
+
+
+def make_dataset(episodes: int, num_pods: int = 96, num_incidents: int = 6,
+                 seed: int = 0) -> list[dict]:
+    return [make_episode(num_pods, num_incidents, seed + e)
+            for e in range(episodes)]
+
+
+def evaluate(params: gnn.Params, batches: Sequence[dict]) -> float:
+    """Top-1 accuracy over the labeled (masked) incidents of ``batches``."""
+    fwd = jax.jit(gnn.forward)   # one wrapper: compile at most once per shape
+    correct = total = 0
+    for b in batches:
+        logits = fwd(
+            params, b["features"], b["node_kind"], b["node_mask"],
+            b["edge_src"], b["edge_dst"], b["edge_mask"], b["incident_nodes"])
+        pred = np.asarray(logits.argmax(axis=-1))
+        mask = np.asarray(b["label_mask"]) > 0
+        correct += int((pred[mask] == np.asarray(b["labels"])[mask]).sum())
+        total += int(mask.sum())
+    return correct / max(total, 1)
+
+
+def train(episodes: int = 8, steps: int = 200, num_pods: int = 96,
+          num_incidents: int = 6, hidden: int = 64, layers: int = 3,
+          lr: float = 3e-3, seed: int = 0, eval_holdout: int = 2,
+          verbose: bool = False) -> dict:
+    """Train on simulator episodes; returns params + metric history.
+
+    The last ``eval_holdout`` episodes are never trained on.
+    """
+    import optax
+
+    if episodes <= eval_holdout:
+        raise ValueError(
+            f"episodes ({episodes}) must exceed eval_holdout ({eval_holdout})")
+    data = make_dataset(episodes, num_pods, num_incidents, seed)
+    holdout = data[len(data) - eval_holdout:] if eval_holdout else []
+    train_set = data[:len(data) - eval_holdout] if eval_holdout else data
+
+    params = gnn.init_params(jax.random.PRNGKey(seed), hidden=hidden, layers=layers)
+    tx = optax.adam(lr)
+    opt_state = tx.init(params)
+    step = gnn.make_train_step(tx)
+
+    history = []
+    for s in range(steps):
+        batch = train_set[s % len(train_set)]
+        params, opt_state, loss = step(params, opt_state, batch)
+        if s % max(steps // 10, 1) == 0 or s == steps - 1:
+            history.append({"step": s, "loss": float(loss)})
+            if verbose:
+                print(f"step {s:5d} loss {float(loss):.4f}", file=sys.stderr)
+
+    metrics = {
+        "train_accuracy": evaluate(params, train_set),
+        "holdout_accuracy": evaluate(params, holdout) if holdout else None,
+        "final_loss": history[-1]["loss"],
+        "history": history,
+    }
+    return {"params": params, "metrics": metrics,
+            "config": {"hidden": hidden, "layers": layers}}
+
+
+# -- checkpointing (orbax; SURVEY.md §5 checkpoint/resume) -----------------
+
+def save_checkpoint(path: str, params: gnn.Params, config: dict) -> None:
+    import orbax.checkpoint as ocp
+    import os
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(os.path.abspath(path), {"params": params, "config": config},
+               force=True)  # allow overwriting a previous run's checkpoint
+
+
+def load_checkpoint(path: str) -> dict:
+    """Restore arrays as plain numpy so a checkpoint written on one
+    platform/topology (e.g. CPU trainer) loads anywhere (e.g. TPU server)."""
+    import orbax.checkpoint as ocp
+    import os
+    ckptr = ocp.PyTreeCheckpointer()
+    path = os.path.abspath(path)
+    meta = ckptr.metadata(path)
+    tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    if tree is None:  # older orbax: metadata() returns the tree directly
+        tree = meta
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), tree)
+    return ckptr.restore(path, restore_args=restore_args)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--episodes", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--pods", type=int, default=96)
+    ap.add_argument("--incidents", type=int, default=6)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint", default="", help="save trained params here")
+    args = ap.parse_args(argv)
+    out = train(episodes=args.episodes, steps=args.steps, num_pods=args.pods,
+                num_incidents=args.incidents, hidden=args.hidden,
+                layers=args.layers, lr=args.lr, seed=args.seed, verbose=True)
+    if args.checkpoint:
+        save_checkpoint(args.checkpoint, out["params"], out["config"])
+    print(json.dumps(out["metrics"]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -158,10 +158,10 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
     import time as _t
     t0 = _t.perf_counter()
     backend_name = ctx.settings.rca_backend
-    if backend_name == "tpu":
+    if backend_name in ("tpu", "gnn"):   # snapshot-scoring backends
         snapshot = build_snapshot(ctx.builder.store, ctx.settings)
-        tpu = get_backend("tpu")
-        all_results = tpu.results(snapshot)
+        backend = get_backend(backend_name)
+        all_results = backend.results(snapshot)
         mine = [r for r in all_results
                 if str(r.incident_id) == str(ctx.incident.id)]
         hyps = mine[0].hypotheses if mine else []

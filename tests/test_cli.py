@@ -1,0 +1,53 @@
+"""Simulator CLI (simulator/cli.py) — hermetic end-to-end runs.
+
+Parity target: the reference Click CLI (incident_simulator.py:274-314)
+whose verbs need a live cluster; here `list` and `run` are fully
+in-process and `run` prints a machine-checkable JSON RCA report.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.simulator.cli import main
+
+
+def test_list_prints_all_scenarios(capsys):
+    from kubernetes_aiops_evidence_graph_tpu.simulator import SCENARIOS
+
+    assert main(["list"]) == 0
+    out = capsys.readouterr().out
+    for name, s in SCENARIOS.items():
+        assert name in out
+        assert s.expected_rule in out
+
+
+def test_run_both_backends_agree_on_expected_rule(capsys):
+    rc = main(["run", "-s", "crashloop_deploy", "-s", "oom",
+               "--pods", "64", "--backend", "both"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["pods"] == 64
+    assert report["graph"]["nodes"] > 0
+    assert len(report["incidents"]) == 2
+    for entry in report["incidents"]:
+        assert entry["cpu_top1"]["rule"] == entry["expected_rule"]
+        assert entry["tpu_top1"]["rule"] == entry["expected_rule"]
+        assert entry["tpu_top1"]["confidence"] == pytest.approx(
+            entry["cpu_top1"]["confidence"], abs=1e-3)
+
+
+def test_run_cpu_only_has_no_graph_section(capsys):
+    rc = main(["run", "-s", "imagepull", "--pods", "48", "--backend", "cpu"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert "graph" not in report
+    (entry,) = report["incidents"]
+    assert "tpu_top1" not in entry
+    assert entry["cpu_top1"]["rule"] == entry["expected_rule"]
+
+
+def test_run_unknown_scenario_fails_with_message(capsys):
+    assert main(["run", "-s", "nope"]) == 2
+    assert "unknown scenario" in capsys.readouterr().err

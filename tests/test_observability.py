@@ -1,0 +1,190 @@
+"""Observability stack — metrics registry, span tracer, structured logging.
+
+The reference promised 9 metrics but emitted 5 (SURVEY.md §3.6 item 7),
+declared OTel but never imported it, and used structlog without configuring
+it (SURVEY.md §5). These tests pin the full, actually-working surface.
+"""
+from __future__ import annotations
+
+import threading
+
+from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
+    Counter, Gauge, Histogram, REGISTRY, Registry,
+)
+from kubernetes_aiops_evidence_graph_tpu.observability.tracing import Tracer
+from kubernetes_aiops_evidence_graph_tpu.observability import get_logger
+
+
+class TestCounter:
+    def test_inc_and_labels(self):
+        c = Counter("test_total")
+        c.inc()
+        c.inc(2.5, source="webhook")
+        assert c.value() == 1.0
+        assert c.value(source="webhook") == 2.5
+        assert c.value(source="other") == 0.0
+
+    def test_exposition_format(self):
+        c = Counter("test_total", "help text")
+        c.inc(3, source="a")
+        lines = list(c.expose())
+        assert lines[0] == "# HELP test_total help text"
+        assert lines[1] == "# TYPE test_total counter"
+        assert 'test_total{source="a"} 3.0' in lines
+
+    def test_concurrent_increments_do_not_lose_updates(self):
+        c = Counter("race_total")
+        n, per = 8, 1000
+
+        def worker():
+            for _ in range(per):
+                c.inc()
+
+        threads = [threading.Thread(target=worker) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value() == n * per
+
+
+class TestGauge:
+    def test_set_overwrites(self):
+        g = Gauge("depth")
+        g.set(5, queue="incidents")
+        g.set(2, queue="incidents")
+        assert g.value(queue="incidents") == 2
+        assert "# TYPE depth gauge" in list(g.expose())
+
+
+class TestHistogram:
+    def test_observe_buckets_sum_count(self):
+        h = Histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        text = "\n".join(h.expose())
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1.0"} 2' in text
+        assert 'lat_seconds_bucket{le="10.0"} 3' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+        assert "lat_seconds_count 4" in text
+        assert "lat_seconds_sum 55.55" in text
+
+    def test_time_context_manager(self):
+        h = Histogram("t_seconds")
+        with h.time(step="collect"):
+            pass
+        assert h._totals[(("step", "collect"),)] == 1
+
+    def test_percentile_upper_bound(self):
+        h = Histogram("p_seconds", buckets=(0.1, 1.0, 10.0))
+        for _ in range(99):
+            h.observe(0.05)
+        h.observe(5.0)
+        assert h.percentile(0.5) == 0.1
+        assert h.percentile(1.0) == 10.0
+        assert Histogram("empty").percentile(0.5) == 0.0
+
+
+class TestRegistry:
+    def test_same_name_returns_same_metric(self):
+        r = Registry()
+        a = r.counter("x_total")
+        b = r.counter("x_total")
+        assert a is b
+
+    def test_reference_promised_metric_surface_is_complete(self):
+        # the 5 real reference metrics (main.py:30-48, base.py:19-23) plus
+        # the 4 promised-but-never-defined ones (SURVEY.md §3.6 item 7)
+        text = REGISTRY.expose()
+        for name in (
+            "aiops_alerts_received_total", "aiops_alerts_deduplicated_total",
+            "aiops_incidents_created_total", "aiops_webhook_latency_seconds",
+            "aiops_collector_duration_seconds",
+            "aiops_incidents_resolved_total", "aiops_remediation_attempts_total",
+            "aiops_hypotheses_generated_total", "aiops_evidence_collected_total",
+        ):
+            assert name in text, f"missing promised metric {name}"
+
+
+class TestTracer:
+    def test_nested_spans_share_trace_and_parent(self):
+        tr = Tracer()
+        with tr.span("workflow", incident="i1") as outer:
+            with tr.span("collect") as inner:
+                pass
+        spans = {s["name"]: s for s in tr.export()}
+        assert spans["collect"]["trace_id"] == spans["workflow"]["trace_id"]
+        assert spans["collect"]["parent_id"] == spans["workflow"]["span_id"]
+        assert spans["workflow"]["parent_id"] is None
+        assert spans["workflow"]["attributes"] == {"incident": "i1"}
+        assert spans["collect"]["duration_ms"] >= 0
+
+    def test_exception_marks_span_status_and_propagates(self):
+        tr = Tracer()
+        try:
+            with tr.span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        (s,) = tr.export()
+        assert s["status"] == "error:ValueError"
+
+    def test_export_filters_by_trace_id(self):
+        tr = Tracer()
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        tid = tr.export()[0]["trace_id"]
+        assert all(s["trace_id"] == tid for s in tr.export(trace_id=tid))
+        assert len(tr.export(trace_id=tid)) == 1
+        tr.clear()
+        assert tr.export() == []
+
+    def test_ring_buffer_caps_spans(self):
+        tr = Tracer(max_spans=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        names = [s["name"] for s in tr.export()]
+        assert names == ["s6", "s7", "s8", "s9"]
+
+
+class TestLogging:
+    def test_kv_logging_emits_configured_line(self):
+        # reconfigure onto our own stream: the process-wide handler may have
+        # bound the original stderr before pytest's capture swapped it
+        import io
+        from kubernetes_aiops_evidence_graph_tpu.observability.logging import configure
+
+        stream = io.StringIO()
+        configure(stream=stream)
+        try:
+            log = get_logger("test")
+            log.info("incident_created", incident_id="abc", severity="high")
+            out = stream.getvalue()
+            assert "event=incident_created" in out
+            assert "incident_id=abc" in out
+            assert "logger=kaeg.test" in out
+        finally:
+            configure()  # restore the stderr handler for later tests
+
+    def test_json_mode_and_bound_fields(self):
+        import io
+        import json as _json
+        from kubernetes_aiops_evidence_graph_tpu.observability.logging import configure
+
+        stream = io.StringIO()
+        configure(stream=stream, as_json=True)
+        try:
+            log = get_logger("test", incident="i-1").bind(step="collect")
+            log.warning("slow", seconds=4.2)
+            rec = _json.loads(stream.getvalue())
+            assert rec["event"] == "slow"
+            assert rec["level"] == "warning"
+            assert rec["incident"] == "i-1"
+            assert rec["step"] == "collect"
+            assert rec["seconds"] == 4.2
+        finally:
+            configure()

@@ -199,3 +199,28 @@ def test_worker_processes_concurrent_incidents():
     statuses = {db.get_incident(i.id)["status"] for i in incidents}
     assert statuses <= {"resolved", "closed"}
     db.close()
+
+
+def test_lifecycle_routes_gnn_backend():
+    """rca_backend=gnn must reach the GNN backend, not silently fall back to
+    the CPU rules engine (code-review regression)."""
+    from kubernetes_aiops_evidence_graph_tpu import rca
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import GnnRcaBackend
+    import jax
+
+    cluster, target, incident, db = _world()
+    # tiny untrained model, injected directly into the backend registry
+    params = gnn.init_params(jax.random.PRNGKey(0), hidden=8, layers=1)
+    rca._INSTANCES["gnn"] = GnnRcaBackend(params=params)
+    try:
+        settings = load_settings(**{**DEV.__dict__, "rca_backend": "gnn"})
+        results = _run(run_incident_workflow(incident, cluster, db, settings=settings))
+        assert results["generate_hypotheses"]["backend"] == "gnn"
+        hyp_rows = db.hypotheses_for(incident.id)
+        assert hyp_rows, "gnn backend produced no hypotheses"
+        # rows came from the GNN path, not the rules engine
+        assert all(r.get("backend", "gnn") == "gnn" for r in hyp_rows)
+    finally:
+        rca._INSTANCES.pop("gnn", None)
+        db.close()
