@@ -2,10 +2,15 @@ from .mesh import dp_sharded, graph_sharded, make_mesh, replicated
 from .multihost import host_local_incident_slice, init_distributed, make_multihost_mesh
 from .partition import PartitionedGraph, partition_snapshot
 from .sharded_gnn import device_put_partitioned, make_sharded_train_step
+from .sharded_rules import (
+    ShardedBatch, device_put_sharded_batch, make_sharded_score, shard_batch,
+)
 
 __all__ = [
     "make_mesh", "replicated", "dp_sharded", "graph_sharded",
     "PartitionedGraph", "partition_snapshot",
     "make_sharded_train_step", "device_put_partitioned",
     "init_distributed", "make_multihost_mesh", "host_local_incident_slice",
+    "ShardedBatch", "shard_batch", "make_sharded_score",
+    "device_put_sharded_batch",
 ]
