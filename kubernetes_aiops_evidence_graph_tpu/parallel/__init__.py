@@ -3,7 +3,8 @@ from .multihost import host_local_incident_slice, init_distributed, make_multiho
 from .partition import PartitionedGraph, partition_snapshot
 from .sharded_gnn import device_put_partitioned, make_sharded_train_step
 from .sharded_rules import (
-    ShardedBatch, device_put_sharded_batch, make_sharded_score, shard_batch,
+    ShardedBatch, device_put_graph_sharded, device_put_sharded_batch,
+    make_graph_sharded_score, make_sharded_score, shard_batch,
 )
 
 __all__ = [
@@ -12,5 +13,6 @@ __all__ = [
     "make_sharded_train_step", "device_put_partitioned",
     "init_distributed", "make_multihost_mesh", "host_local_incident_slice",
     "ShardedBatch", "shard_batch", "make_sharded_score",
-    "device_put_sharded_batch",
+    "device_put_sharded_batch", "make_graph_sharded_score",
+    "device_put_graph_sharded",
 ]

@@ -148,3 +148,124 @@ def device_put_sharded_batch(sb: ShardedBatch, mesh: Mesh) -> tuple:
         jax.device_put(sb.pair_mask, dp),
         jax.device_put(sb.pair_rows, dp), jax.device_put(sb.pair_rows_mask, dp),
     )
+
+
+# -- graph-sharded variant: node features split over the 'graph' axis ------
+#
+# When the feature matrix outgrows one chip's HBM (millions of nodes), the
+# dp-replicated layout above stops working. Here features are sharded into G
+# contiguous node blocks over the 'graph' mesh axis and the evidence fold
+# becomes a RING: each of the G steps holds one remote feature block
+# (ppermute over 'graph', the ring-attention pattern of sharded_gnn), folds
+# the evidence slots whose global node id lives in that block, and rotates.
+# Per-shard memory is O(Pn/G · DIM); every (dp, graph) shard sees every
+# block once, so after G steps counts are complete and the shared
+# finish_scores tail runs unchanged. Compute is replicated across the graph
+# axis (the fold is cheap — the axis exists for capacity, not FLOPs).
+
+from .sharded_gnn import _ring_perm  # noqa: E402 — shared ring permutation
+
+
+def make_graph_sharded_score(mesh: Mesh, rows_per_shard: int, num_pairs: int,
+                             nodes_per_shard: int):
+    """shard_map'd scoring over a (dp × graph) mesh with sharded features.
+
+    fn(features_blocks [G, Pn/G, DIM], ev_idx, ev_cnt, pair_ids, pair_pod,
+    pair_mask, pair_rows, pair_rows_mask) -> global [Pi, ...] outputs."""
+    from ..graph.schema import F
+    from ..rca.tpu_backend import _FOLD_CHUNK, finish_scores
+
+    g_size = mesh.shape["graph"]
+
+    def local_score(features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
+                    pair_rows, pair_rows_mask):
+        blk = features[0]                       # [Pn/G, DIM] my node block
+        ev_idx_, ev_cnt_ = ev_idx[0], ev_cnt[0]
+        pair_ids_, pair_pod_, pair_mask_ = pair_ids[0], pair_pod[0], pair_mask[0]
+        pair_rows_, pair_rows_mask_ = pair_rows[0], pair_rows_mask[0]
+
+        my = jax.lax.axis_index("graph")
+        slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx_.shape, 1)
+                     < ev_cnt_[:, None]).astype(blk.dtype)    # [rows, W]
+
+        width = ev_idx_.shape[1]
+
+        def _fold_block(h_blk, lo):
+            """Chunked fold of slots whose node id lives in [lo, lo+nps):
+            bounds the [rows, chunk, DIM] intermediate exactly like the
+            single-device _aggregate does (tpu_backend._FOLD_CHUNK)."""
+            def fold_slice(idx, live):
+                in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
+                          ).astype(h_blk.dtype) * live
+                local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
+                return (h_blk[local] * in_blk[:, :, None]).sum(axis=1)
+
+            if width <= _FOLD_CHUNK:
+                return fold_slice(ev_idx_, slot_live)
+            def chunk_body(acc, i):
+                sl_i = jax.lax.dynamic_slice_in_dim(
+                    ev_idx_, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+                sl_m = jax.lax.dynamic_slice_in_dim(
+                    slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+                return acc + fold_slice(sl_i, sl_m), None
+            out, _ = jax.lax.scan(
+                chunk_body,
+                jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
+                jnp.arange(width // _FOLD_CHUNK))
+            return out
+
+        def body(r, carry):
+            h_blk, counts, pod_prob = carry
+            src_shard = jnp.mod(my - r, g_size)
+            lo = src_shard * nodes_per_shard
+            counts = counts + _fold_block(h_blk, lo)
+            p_in = ((pair_pod_ >= lo) & (pair_pod_ < lo + nodes_per_shard)
+                    ).astype(h_blk.dtype) * pair_mask_
+            p_local = jnp.clip(pair_pod_ - lo, 0, nodes_per_shard - 1)
+            pod_prob = pod_prob + h_blk[p_local, F.POD_PROBLEM] * p_in
+            h_blk = jax.lax.ppermute(h_blk, "graph", _ring_perm(g_size))
+            return h_blk, counts, pod_prob
+
+        _, counts, pod_prob = jax.lax.fori_loop(
+            0, g_size, body,
+            (blk,
+             jnp.zeros((rows_per_shard, blk.shape[1]), jnp.float32),
+             jnp.zeros((pair_pod_.shape[0],), jnp.float32)))
+
+        per_pair = jnp.zeros((num_pairs,), jnp.float32
+                             ).at[pair_ids_].add(pod_prob)
+        per_row_max = jnp.zeros((rows_per_shard,), jnp.float32
+                                ).at[pair_rows_].max(per_pair * pair_rows_mask_)
+        return finish_scores(counts, per_row_max, rows_per_shard)
+
+    dp_spec = P("dp")
+    sharded = shard_map(
+        local_score,
+        mesh=mesh,
+        in_specs=(P("graph"),                   # feature blocks
+                  dp_spec, dp_spec,             # evidence table
+                  dp_spec, dp_spec, dp_spec,    # pair entries
+                  dp_spec, dp_spec),            # pair rows
+        out_specs=tuple([dp_spec] * 7),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def device_put_graph_sharded(sb: ShardedBatch, mesh: Mesh,
+                             graph: int) -> tuple:
+    """Place arrays for the graph-sharded pass: features split into
+    ``graph`` contiguous node blocks, everything else dp-sharded."""
+    pn = sb.features.shape[0]
+    if pn % graph:
+        raise ValueError(f"padded nodes {pn} not divisible by graph={graph}")
+    blocks = sb.features.reshape(graph, pn // graph, -1)
+    gsh = NamedSharding(mesh, P("graph"))
+    dp = NamedSharding(mesh, P("dp"))
+    return (
+        jax.device_put(blocks, gsh),
+        jax.device_put(sb.ev_idx, dp), jax.device_put(sb.ev_cnt, dp),
+        jax.device_put(sb.pair_ids, dp), jax.device_put(sb.pair_pod, dp),
+        jax.device_put(sb.pair_mask, dp),
+        jax.device_put(sb.pair_rows, dp), jax.device_put(sb.pair_rows_mask, dp),
+    )

@@ -235,7 +235,14 @@ def _score_device(
         features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
         pair_rows, pair_rows_mask, padded_incidents, num_pairs)
     counts = counts + jnp.minimum(chain, 0.0)[:, None]
+    return finish_scores(counts, per_row_max, padded_incidents)
 
+
+def finish_scores(counts, per_row_max, padded_incidents: int):
+    """counts [Pi, DIM] + per_row_max [Pi] → full scoring outputs.
+
+    Shared tail of the XLA path; also used by the graph-sharded pass
+    (parallel/sharded_rules.py) after its ring fold."""
     # 3) condition vector [Pi, NUM_CONDS]
     c = counts
     conds = jnp.zeros((padded_incidents, NUM_CONDS), jnp.float32)
@@ -342,6 +349,12 @@ class TpuRcaBackend:
             padded_incidents=batch.padded_incidents,
             num_pairs=int(batch.pair_rows.shape[0]),
         )
+
+    def prepared(self, snapshot: GraphSnapshot) -> DeviceBatch:
+        """Public access to the (cached) host-prepared batch — used by the
+        sharded scoring paths so they don't re-run prep or touch internals."""
+        batch, _, _ = self._load(snapshot)
+        return batch
 
     def score_snapshot(self, snapshot: GraphSnapshot) -> dict:
         """Score every incident in the snapshot in one device pass.
