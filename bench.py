@@ -271,6 +271,7 @@ def bench_streaming(num_pods: int, num_incidents: int, events: int,
 
     scorer = StreamingScorer(builder.store, settings)
     scorer.rescore()  # warm compile (+ one fetch)
+    scorer.warm()     # pre-compile the real tick-delta bucket shapes
 
     # Each tick applies events and enqueues a re-score WITHOUT a synchronous
     # host fetch (scorer.dispatch) — results stay device-resident and are

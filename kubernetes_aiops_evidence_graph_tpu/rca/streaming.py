@@ -11,6 +11,7 @@ re-run the vectorized numpy prep join (~ms), never a full snapshot rebuild.
 from __future__ import annotations
 
 import time
+from functools import partial
 from typing import Iterable
 
 import numpy as np
@@ -23,15 +24,31 @@ from ..graph.schema import RelationKind
 from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_features
 from ..graph.store import EvidenceGraphStore
 from ..utils.padding import bucket_for
-from .tpu_backend import _score_device, prepare_batch
+from .tpu_backend import (
+    DeviceBatch, dense_evidence_table, evidence_coo, pair_tables,
+)
 
 _DELTA_BUCKETS = (64, 256, 1024, 4096, 16384)
 
 
-@jax.jit
-def _apply_feature_updates(features, idx, rows):
-    # padded idx entries point past the array end -> dropped
-    return features.at[idx].set(rows, mode="drop")
+@partial(jax.jit, static_argnames=("padded_incidents", "num_pairs"))
+def _update_and_score(features, idx, rows, ev_idx, ev_cnt, pair_ids,
+                      pair_pod, pair_mask, pair_rows, pair_rows_mask,
+                      chain, padded_incidents: int, num_pairs: int):
+    """One fused device call per tick: apply the padded feature delta, then
+    score — halves per-tick dispatches vs update-then-score (each dispatch
+    costs real latency on a tunneled TPU). The caller replaces its features
+    handle with the returned buffer. No buffer donation: the axon-tunneled
+    backend measurably slows down with donated inputs, and the on-device
+    [Pn, DIM] copy is ~µs."""
+    from .tpu_backend import _aggregate, finish_scores
+
+    features = features.at[idx].set(rows, mode="drop")
+    counts, per_row_max = _aggregate(
+        features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
+        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+    counts = counts + jnp.minimum(chain, 0.0)[:, None]
+    return (features,) + finish_scores(counts, per_row_max, padded_incidents)
 
 
 class StreamingScorer:
@@ -46,8 +63,20 @@ class StreamingScorer:
         nodes, _ = store._raw()
         self._nodes_by_id = {node.id: node for node in nodes}
         self._features_dev = jnp.asarray(self.snapshot.features)
-        self._batch = prepare_batch(self.snapshot)
-        self._edge_args = self._upload_edges()
+        # evidence COO is invariant under reschedules — computed once, and
+        # cached so structural flushes re-run ONLY the pair join (the dense
+        # evidence table and its device upload stay resident)
+        self._ev_coo = evidence_coo(self.snapshot)
+        pi = self.snapshot.padded_incidents
+        ev_idx, ev_cnt = dense_evidence_table(*self._ev_coo, pi)
+        pair = pair_tables(self.snapshot, *self._ev_coo)
+        self._batch = DeviceBatch(
+            num_incidents=self.snapshot.num_incidents, padded_incidents=pi,
+            ev_idx=ev_idx, ev_cnt=ev_cnt, pair_ids=pair[0], pair_pod=pair[1],
+            pair_mask=pair[2], pair_rows=pair[3], pair_rows_mask=pair[4],
+            features=self.snapshot.features)
+        self._ev_args = (jnp.asarray(ev_idx), jnp.asarray(ev_cnt))
+        self._pair_args = self._upload_pairs()
         # edge-position index for SCHEDULED_ON retargets: pod idx -> positions
         self._sched_pos: dict[int, list[int]] = {}
         live = self.snapshot.edge_mask > 0
@@ -62,13 +91,12 @@ class StreamingScorer:
         self._pending_rows: list[np.ndarray] = []
         self._structural_dirty = False
 
-    def _upload_edges(self) -> tuple:
+    def _upload_pairs(self) -> tuple:
         b = self._batch
         # no block_until_ready: XLA orders the h2d copies before first use,
         # and forcing them costs a ~70 ms sync per structural flush on the
         # dev tunnel
         return (
-            jnp.asarray(b.ev_idx), jnp.asarray(b.ev_cnt),
             jnp.asarray(b.pair_ids), jnp.asarray(b.pair_pod), jnp.asarray(b.pair_mask),
             jnp.asarray(b.pair_rows), jnp.asarray(b.pair_rows_mask),
         )
@@ -106,44 +134,75 @@ class StreamingScorer:
 
     # -- scoring ----------------------------------------------------------
 
-    def _flush(self) -> dict:
-        stats = {"feature_updates": len(self._pending_idx),
-                 "structural_refresh": self._structural_dirty}
-        if self._pending_idx:
-            k = len(self._pending_idx)
-            pk = bucket_for(k, _DELTA_BUCKETS)
-            pn = self.snapshot.padded_nodes
-            idx = np.full(pk, pn, dtype=np.int32)  # out-of-range -> dropped
+    def _pending_delta(self) -> tuple[np.ndarray, np.ndarray]:
+        """Drain queued feature updates into padded (idx, rows) arrays."""
+        k = len(self._pending_idx)
+        pk = bucket_for(max(k, 1), _DELTA_BUCKETS)
+        pn = self.snapshot.padded_nodes
+        idx = np.full(pk, pn, dtype=np.int32)      # out-of-range -> dropped
+        rows = np.zeros((pk, self.snapshot.features.shape[1]), np.float32)
+        if k:
             idx[:k] = self._pending_idx
-            rows = np.zeros((pk, self.snapshot.features.shape[1]), np.float32)
             rows[:k] = np.stack(self._pending_rows)
-            self._features_dev = _apply_feature_updates(
-                self._features_dev, jnp.asarray(idx), jnp.asarray(rows))
             self._pending_idx.clear()
             self._pending_rows.clear()
-        if self._structural_dirty:
-            self._batch = prepare_batch(self.snapshot)
-            self._edge_args = self._upload_edges()
-            self._structural_dirty = False
-        return stats
+        return idx, rows
+
+    def _refresh_pairs(self) -> None:
+        # reschedules only retarget SCHEDULED_ON edges: the evidence table
+        # is untouched, so refresh just the five pair arrays
+        from dataclasses import replace
+        pair = pair_tables(self.snapshot, *self._ev_coo)
+        self._batch = replace(
+            self._batch, pair_ids=pair[0], pair_pod=pair[1],
+            pair_mask=pair[2], pair_rows=pair[3], pair_rows_mask=pair[4])
+        self._pair_args = self._upload_pairs()
+        self._structural_dirty = False
+
+    def warm(self, delta_sizes: tuple[int, ...] = (64, 256)) -> None:
+        """Pre-compile the fused tick program for the given delta buckets so
+        the first real tick doesn't pay a compile (each distinct padded
+        delta size is a distinct XLA program)."""
+        pn = self.snapshot.padded_nodes
+        dim = self.snapshot.features.shape[1]
+        chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
+        for pk in delta_sizes:
+            idx = np.full(pk, pn, dtype=np.int32)   # all-dropped delta
+            rows = np.zeros((pk, dim), np.float32)
+            out = _update_and_score(
+                self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
+                *self._ev_args, *self._pair_args, chain,
+                padded_incidents=self._batch.padded_incidents,
+                num_pairs=int(self._batch.pair_rows.shape[0]))
+            self._features_dev = out[0]   # no-op update; keep handle fresh
 
     def dispatch(self) -> tuple:
         """Flush pending deltas and enqueue one scoring pass; returns the
         device result handles without a host fetch. The steady-state tick
-        path: on co-located hosts the fetch is microseconds, but it can be
-        overlapped/batched (the dev tunnel charges ~75 ms per synchronous
-        fetch — see tpu_backend.dispatch)."""
-        self._flush()
-        return _score_device(
-            self._features_dev, *self._edge_args,
-            jnp.zeros((self._batch.padded_incidents,), jnp.float32),  # chain
+        path (feature deltas only) is ONE fused device call: apply the
+        padded delta + score. On co-located hosts the fetch is
+        microseconds, but it can be overlapped/batched (the dev tunnel
+        charges ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
+        if self._structural_dirty:
+            self._refresh_pairs()  # rare path; the feature delta rides the
+                                   # fused call below either way
+        chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
+        idx, rows = self._pending_delta()
+        out = _update_and_score(
+            self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
+            *self._ev_args, *self._pair_args, chain,
             padded_incidents=self._batch.padded_incidents,
             num_pairs=int(self._batch.pair_rows.shape[0]),
         )
+        self._features_dev = out[0]
+        return out[1:]
 
     def rescore(self) -> dict:
+        stats = {"feature_updates": len(self._pending_idx),
+                 "structural_refresh": self._structural_dirty}
         t0 = time.perf_counter()
-        stats = self._flush()
+        if self._structural_dirty:
+            self._refresh_pairs()
         flush_s = time.perf_counter() - t0
         t1 = time.perf_counter()
         out = self.dispatch()

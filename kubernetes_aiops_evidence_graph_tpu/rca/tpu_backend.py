@@ -85,11 +85,12 @@ class DeviceBatch:
     features: np.ndarray       # [Pn, DIM] f32
 
 
-def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
-    """Host-side O(E) prep from a snapshot (pure numpy)."""
-    pi = snapshot.padded_incidents
+def evidence_coo(snapshot: GraphSnapshot) -> tuple[np.ndarray, np.ndarray]:
+    """Live evidence edges as (incident row, entity node) COO arrays.
 
-    # map node index -> incident row (or -1)
+    AFFECTS / CORRELATES_WITH edges whose src is an incident (undirected
+    duplicates whose *dst* is the incident are dropped here). Invariant
+    under pod reschedules — the streaming path caches this."""
     inc_row = np.full(snapshot.padded_nodes, -1, dtype=np.int64)
     real = snapshot.incident_mask > 0
     inc_row[snapshot.incident_nodes[real]] = np.arange(int(real.sum()))
@@ -98,16 +99,15 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     src = snapshot.edge_src[live]
     dst = snapshot.edge_dst[live]
     rel = snapshot.edge_rel[live]
-
-    # evidence edges: AFFECTS / CORRELATES_WITH whose src is an incident
-    # (undirected duplicates whose *dst* is the incident are dropped here)
     is_ev = ((rel == int(RelationKind.AFFECTS)) | (rel == int(RelationKind.CORRELATES_WITH)))
     is_ev &= inc_row[src] >= 0
-    ev_rows = inc_row[src[is_ev]]
-    ev_dst = dst[is_ev].astype(np.int64)
+    return inc_row[src[is_ev]], dst[is_ev].astype(np.int64)
 
-    # dense [Pi, W] slot table: sort edges by incident row, then place each
-    # edge at its within-row slot (order-stable w.r.t. the COO order)
+
+def dense_evidence_table(ev_rows: np.ndarray, ev_dst: np.ndarray,
+                         pi: int) -> tuple[np.ndarray, np.ndarray]:
+    """[Pi, W] slot table + per-row counts from the COO: sort edges by
+    incident row, place each at its within-row slot (order-stable)."""
     order = np.argsort(ev_rows, kind="stable")
     rows_s, dst_s = ev_rows[order], ev_dst[order]
     cnt = np.bincount(rows_s, minlength=pi) if len(rows_s) else np.zeros(pi, np.int64)
@@ -117,10 +117,24 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
         starts = np.concatenate([[0], np.cumsum(cnt)])
         slots = np.arange(len(rows_s)) - starts[rows_s]
         ev_idx[rows_s, slots] = dst_s
+    return ev_idx, cnt.astype(np.int32)
 
-    # join incident->pod with pod->node (SCHEDULED_ON, original direction =
-    # pod side is src; reversed duplicates have a Node as src) — fully
-    # vectorized numpy hash-free join via a node_of_pod lookup table
+
+def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
+                ev_dst: np.ndarray) -> tuple:
+    """(incident, node) pair compaction for multiple_pods_same_node.
+
+    Joins incident->pod evidence with pod->node SCHEDULED_ON edges; the
+    only part of the batch that changes on a pod reschedule, so the
+    streaming path refreshes just these five small arrays."""
+    pi = snapshot.padded_incidents
+    live = snapshot.edge_mask > 0
+    src = snapshot.edge_src[live]
+    dst = snapshot.edge_dst[live]
+    rel = snapshot.edge_rel[live]
+
+    # original direction = pod side is src; reversed duplicates have a Node
+    # as src — fully vectorized numpy join via a node_of_pod lookup table
     from ..graph.schema import EntityKind
     is_sched = rel == int(RelationKind.SCHEDULED_ON)
     pod_side = is_sched & (snapshot.node_kind[src] == int(EntityKind.POD))
@@ -132,7 +146,6 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     pr_pods = ev_dst[on_node]
     pr_nodes = node_of_pod[ev_dst[on_node]]
 
-    # compact (row, node) pairs
     if len(pr_rows):
         pair_key = pr_rows.astype(np.int64) << 32 | pr_nodes
         uniq, pair_ids = np.unique(pair_key, return_inverse=True)
@@ -151,16 +164,26 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
 
     pair_mask = np.zeros(pc, np.float32); pair_mask[:len(pr_rows)] = 1.0
     pair_rows_mask = np.zeros(pp, np.float32); pair_rows_mask[:len(pair_rows_real)] = 1.0
+    return (_pad(pair_ids, pc, fill=pp - 1), _pad(pr_pods, pc), pair_mask,
+            _pad(pair_rows_real, pp, fill=pi - 1), pair_rows_mask)
 
+
+def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
+    """Host-side O(E) prep from a snapshot (pure numpy)."""
+    pi = snapshot.padded_incidents
+    ev_rows, ev_dst = evidence_coo(snapshot)
+    ev_idx, ev_cnt = dense_evidence_table(ev_rows, ev_dst, pi)
+    pair_ids, pair_pod, pair_mask, pair_rows, pair_rows_mask = pair_tables(
+        snapshot, ev_rows, ev_dst)
     return DeviceBatch(
         num_incidents=snapshot.num_incidents,
         padded_incidents=pi,
         ev_idx=ev_idx,
-        ev_cnt=cnt.astype(np.int32),
-        pair_ids=_pad(pair_ids, pc, fill=pp - 1),
-        pair_pod=_pad(pr_pods, pc),
+        ev_cnt=ev_cnt,
+        pair_ids=pair_ids,
+        pair_pod=pair_pod,
         pair_mask=pair_mask,
-        pair_rows=_pad(pair_rows_real, pp, fill=pi - 1),
+        pair_rows=pair_rows,
         pair_rows_mask=pair_rows_mask,
         features=snapshot.features,
     )
