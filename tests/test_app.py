@@ -1,0 +1,104 @@
+"""App composition root (app.py) — full HTTP round trip in one process.
+
+The lifecycle the reference splits across docker-compose services
+(aiops-api + aiops-worker + Temporal, docker-compose.yml:205-253), driven
+end-to-end over real HTTP: webhook in → workflow runs → hypotheses,
+runbook, graph, actions, metrics out.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.app import AiopsApp
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+
+@pytest.fixture(scope="module")
+def served():
+    cluster = generate_cluster(num_pods=96, seed=0)
+    inject(cluster, "crashloop_deploy", "default/svc-0", np.random.default_rng(0))
+    settings = load_settings(
+        api_port=0, db_path=":memory:", app_env="development",
+        remediation_dry_run=False, verification_wait_seconds=0,
+        rca_backend="cpu",
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    app = AiopsApp(cluster, settings)
+    port = app.start(host="127.0.0.1")
+    yield app, f"http://127.0.0.1:{port}"
+    app.stop()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+ALERT = {"alerts": [{"status": "firing", "labels": {
+    "alertname": "PodCrashLooping", "namespace": "default",
+    "severity": "critical", "service": "svc-0", "category": "crashloop"},
+    "annotations": {"summary": "pod crash looping"}}]}
+
+
+def test_webhook_to_resolution_over_http(served):
+    app, base = served
+    assert _get(base, "/health")["status"] == "healthy"
+    assert _get(base, "/health/ready")["ready"] is True
+
+    created = _post(base, "/api/v1/webhooks/alertmanager", ALERT)["created"]
+    assert len(created) == 1
+    iid = created[0]
+
+    deadline = time.monotonic() + 120
+    state = None
+    while time.monotonic() < deadline:
+        state = _get(base, f"/api/v1/incidents/{iid}/status").get("state")
+        if state == "completed":
+            break
+        time.sleep(0.25)
+    assert state == "completed"
+
+    hyps = _get(base, f"/api/v1/incidents/{iid}/hypotheses")["hypotheses"]
+    assert hyps[0]["rule_id"] == "crashloop_recent_deploy"
+
+    runbook = _get(base, f"/api/v1/incidents/{iid}/runbook")
+    assert runbook["steps"]
+
+    graph = _get(base, f"/api/v1/incidents/{iid}/graph?depth=3")
+    assert len(graph["nodes"]) > 1   # incident + evidence entities
+
+    actions = _get(base, f"/api/v1/incidents/{iid}/actions")["actions"]
+    assert actions and actions[0]["action_type"] == "rollback_deployment"
+
+    inc = _get(base, f"/api/v1/incidents/{iid}")
+    assert inc["status"] == "resolved"
+
+    with urllib.request.urlopen(base + "/metrics") as r:
+        metrics = r.read().decode()
+    assert "aiops_incidents_created_total" in metrics
+    assert "aiops_incidents_resolved_total" in metrics
+
+
+def test_duplicate_webhook_is_deduplicated(served):
+    app, base = served
+    alert = json.loads(json.dumps(ALERT))
+    alert["alerts"][0]["labels"]["alertname"] = "PodCrashLoopingDup"
+    first = _post(base, "/api/v1/webhooks/alertmanager", alert)
+    out = _post(base, "/api/v1/webhooks/alertmanager", alert)
+    assert len(first["created"]) == 1
+    assert out["created"] == []
+    assert out["duplicates"] == 1
