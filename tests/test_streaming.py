@@ -103,3 +103,32 @@ def test_churn_event_determinism():
     ev1 = [(e.kind, e.namespace, e.name) for e in churn_events(cluster1, 50, seed=7)]
     ev2 = [(e.kind, e.namespace, e.name) for e in churn_events(cluster2, 50, seed=7)]
     assert ev1 == ev2
+
+
+def test_steady_state_ticks_never_recompile():
+    """Static-shape discipline: once the tick-delta bucket shapes are warm,
+    churn ticks must hit the jit cache (each distinct padded shape is a new
+    XLA program; recompiles inside the hot loop would dominate latency)."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        StreamingScorer, _update_and_score,
+    )
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        apply_event, churn_events, sync_touched_to_store,
+    )
+
+    cluster, builder, _incidents = _world()
+    scorer = StreamingScorer(builder.store, SMALL)
+    scorer.warm(delta_sizes=(64, 256))
+    scorer.dispatch()
+    baseline = _update_and_score._cache_size()
+
+    for ev in churn_events(cluster, 120, seed=5):
+        touched = apply_event(cluster, ev)
+        sync_touched_to_store(cluster, builder.store, touched)
+        if ev.kind == "reschedule" and touched:
+            scorer.reschedule_pod(touched[0], f"node:{ev.payload['node']}")
+        scorer.update_nodes(touched)
+        scorer.dispatch()   # one tick per event: delta sizes 0-2 -> bucket 64
+
+    assert _update_and_score._cache_size() == baseline, (
+        "steady-state ticks recompiled the fused kernel")
