@@ -11,9 +11,8 @@ feature broadcast. This is how one slice scores millions of open incidents:
 throughput scales linearly in D while the per-shard pass keeps the
 single-chip shape the compiler already knows.
 
-The pair tables (multiple_pods_same_node condition) are partitioned by
-incident row on the host, so the per-(incident, node) compaction stays
-shard-local too.
+All batch arrays are row-aligned ([Pi, ...]), including the per-slot pair
+ids for multiple_pods_same_node, so sharding is a pure reshape.
 """
 from __future__ import annotations
 
@@ -27,9 +26,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..rca.tpu_backend import DeviceBatch, _score_device
-from ..utils.padding import bucket_for
-
-_PAIR_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 
 
 @dataclass(frozen=True)
@@ -38,13 +34,10 @@ class ShardedBatch:
     num_shards: int
     rows_per_shard: int          # Pi/D
     num_incidents: int
+    pair_width: int
     ev_idx: np.ndarray           # [D, Pi/D, W]
     ev_cnt: np.ndarray           # [D, Pi/D]
-    pair_ids: np.ndarray         # [D, Pc']
-    pair_pod: np.ndarray         # [D, Pc']
-    pair_mask: np.ndarray        # [D, Pc']
-    pair_rows: np.ndarray        # [D, Pp'] — shard-local incident row
-    pair_rows_mask: np.ndarray   # [D, Pp']
+    ev_pair_slot: np.ndarray     # [D, Pi/D, W]
     features: np.ndarray         # [Pn, DIM] replicated
 
 
@@ -54,83 +47,35 @@ def shard_batch(batch: DeviceBatch, dp: int) -> ShardedBatch:
     if pi % dp:
         raise ValueError(f"padded incidents {pi} not divisible by dp={dp}")
     rows = pi // dp
-
-    ev_idx = batch.ev_idx.reshape(dp, rows, -1)
-    ev_cnt = batch.ev_cnt.reshape(dp, rows)
-
-    # partition live pairs by the shard owning their incident row
-    live_c = batch.pair_mask > 0
-    live_p = batch.pair_rows_mask > 0
-    pr_rows = batch.pair_rows[live_p]            # [P_live] global row per pair
-    ids_live = batch.pair_ids[live_c]
-    pod_live = batch.pair_pod[live_c]
-    owner_p = pr_rows // rows
-    # pair entries ([Pc]) reference compact pair ids; a pair's owner is the
-    # owner of its incident row
-    owner_c = owner_p[ids_live]
-
-    cnt_c = np.bincount(owner_c, minlength=dp) if owner_c.size else np.zeros(dp, int)
-    cnt_p = np.bincount(owner_p, minlength=dp) if owner_p.size else np.zeros(dp, int)
-    pc = bucket_for(max(int(cnt_c.max()), 1), _PAIR_BUCKETS)
-    pp = bucket_for(max(int(cnt_p.max()), 1), _PAIR_BUCKETS)
-
-    pair_ids = np.full((dp, pc), pp - 1, np.int32)
-    pair_pod = np.zeros((dp, pc), np.int32)
-    pair_mask = np.zeros((dp, pc), np.float32)
-    pair_rows = np.full((dp, pp), rows - 1, np.int32)
-    pair_rows_mask = np.zeros((dp, pp), np.float32)
-
-    for d in range(dp):
-        sel_p = owner_p == d
-        kp = int(sel_p.sum())
-        # re-index this shard's compact pairs 0..kp-1
-        old_ids = np.nonzero(sel_p)[0]
-        remap = np.full(len(pr_rows) or 1, -1, np.int64)
-        if kp:
-            remap[old_ids] = np.arange(kp)
-            pair_rows[d, :kp] = pr_rows[sel_p] - d * rows   # shard-local row
-            pair_rows_mask[d, :kp] = 1.0
-        sel_c = owner_c == d
-        kc = int(sel_c.sum())
-        if kc:
-            pair_ids[d, :kc] = remap[ids_live[sel_c]]
-            pair_pod[d, :kc] = pod_live[sel_c]
-            pair_mask[d, :kc] = 1.0
-
     return ShardedBatch(
         num_shards=dp, rows_per_shard=rows, num_incidents=batch.num_incidents,
-        ev_idx=ev_idx.astype(np.int32), ev_cnt=ev_cnt.astype(np.int32),
-        pair_ids=pair_ids, pair_pod=pair_pod, pair_mask=pair_mask,
-        pair_rows=pair_rows, pair_rows_mask=pair_rows_mask,
+        pair_width=batch.pair_width,
+        ev_idx=batch.ev_idx.reshape(dp, rows, -1).astype(np.int32),
+        ev_cnt=batch.ev_cnt.reshape(dp, rows).astype(np.int32),
+        ev_pair_slot=batch.ev_pair_slot.reshape(dp, rows, -1).astype(np.int32),
         features=batch.features,
     )
 
 
-def make_sharded_score(mesh: Mesh, rows_per_shard: int, num_pairs: int):
+def make_sharded_score(mesh: Mesh, rows_per_shard: int, pair_width: int):
     """shard_map'd scoring pass over the mesh's ``dp`` axis.
 
-    Returns a jitted fn(features, ev_idx, ev_cnt, pair_ids, pair_pod,
-    pair_mask, pair_rows, pair_rows_mask). Each shard emits its [Pi/D, ...]
-    block and shard_map concatenates them back to global [Pi, ...] outputs
-    (conds, matched, scores, top_idx, any_match, top_conf, top_score) in
-    original row order (rows were split contiguously)."""
+    Returns a jitted fn(features, ev_idx, ev_cnt, ev_pair_slot). Each shard
+    emits its [Pi/D, ...] block and shard_map concatenates them back to
+    global [Pi, ...] outputs (conds, matched, scores, top_idx, any_match,
+    top_conf, top_score) in original row order (rows split contiguously)."""
 
-    def local_score(features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-                    pair_rows, pair_rows_mask):
+    def local_score(features, ev_idx, ev_cnt, ev_pair_slot):
         zero = jnp.zeros((rows_per_shard,), jnp.float32)
         return _score_device.__wrapped__(
-            features, ev_idx[0], ev_cnt[0], pair_ids[0], pair_pod[0],
-            pair_mask[0], pair_rows[0], pair_rows_mask[0], zero,
-            padded_incidents=rows_per_shard, num_pairs=num_pairs)
+            features, ev_idx[0], ev_cnt[0], ev_pair_slot[0], zero,
+            padded_incidents=rows_per_shard, pair_width=pair_width)
 
     dp_spec = P("dp")
     sharded = shard_map(
         local_score,
         mesh=mesh,
-        in_specs=(P(),            # features replicated
-                  dp_spec, dp_spec,                       # evidence table
-                  dp_spec, dp_spec, dp_spec,              # pair entries
-                  dp_spec, dp_spec),                      # pair rows
+        in_specs=(P(), dp_spec, dp_spec, dp_spec),  # features replicated
         out_specs=tuple([dp_spec] * 7),
         check_vma=False,
     )
@@ -144,9 +89,7 @@ def device_put_sharded_batch(sb: ShardedBatch, mesh: Mesh) -> tuple:
     return (
         jax.device_put(sb.features, rep),
         jax.device_put(sb.ev_idx, dp), jax.device_put(sb.ev_cnt, dp),
-        jax.device_put(sb.pair_ids, dp), jax.device_put(sb.pair_pod, dp),
-        jax.device_put(sb.pair_mask, dp),
-        jax.device_put(sb.pair_rows, dp), jax.device_put(sb.pair_rows_mask, dp),
+        jax.device_put(sb.ev_pair_slot, dp),
     )
 
 
@@ -166,86 +109,81 @@ def device_put_sharded_batch(sb: ShardedBatch, mesh: Mesh) -> tuple:
 from .sharded_gnn import _ring_perm  # noqa: E402 — shared ring permutation
 
 
-def make_graph_sharded_score(mesh: Mesh, rows_per_shard: int, num_pairs: int,
-                             nodes_per_shard: int):
+def make_graph_sharded_score(mesh: Mesh, rows_per_shard: int,
+                             nodes_per_shard: int, pair_width: int):
     """shard_map'd scoring over a (dp × graph) mesh with sharded features.
 
-    fn(features_blocks [G, Pn/G, DIM], ev_idx, ev_cnt, pair_ids, pair_pod,
-    pair_mask, pair_rows, pair_rows_mask) -> global [Pi, ...] outputs."""
+    fn(features_blocks [G, Pn/G, DIM], ev_idx, ev_cnt, ev_pair_slot) ->
+    global [Pi, ...] outputs."""
     from ..graph.schema import F
-    from ..rca.tpu_backend import _FOLD_CHUNK, finish_scores
+    from ..rca.tpu_backend import _FOLD_CHUNK, finish_scores, pair_contract
 
     g_size = mesh.shape["graph"]
 
-    def local_score(features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-                    pair_rows, pair_rows_mask):
+    def local_score(features, ev_idx, ev_cnt, ev_pair_slot):
         blk = features[0]                       # [Pn/G, DIM] my node block
         ev_idx_, ev_cnt_ = ev_idx[0], ev_cnt[0]
-        pair_ids_, pair_pod_, pair_mask_ = pair_ids[0], pair_pod[0], pair_mask[0]
-        pair_rows_, pair_rows_mask_ = pair_rows[0], pair_rows_mask[0]
+        pair_slot_ = ev_pair_slot[0]
 
         my = jax.lax.axis_index("graph")
         slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx_.shape, 1)
                      < ev_cnt_[:, None]).astype(blk.dtype)    # [rows, W]
-
         width = ev_idx_.shape[1]
 
         def _fold_block(h_blk, lo):
             """Chunked fold of slots whose node id lives in [lo, lo+nps):
             bounds the [rows, chunk, DIM] intermediate exactly like the
-            single-device _aggregate does (tpu_backend._FOLD_CHUNK)."""
-            def fold_slice(idx, live):
+            single-device _aggregate; the pair one-hot contraction rides the
+            same in-block gathered rows."""
+            def fold_slice(idx, pslot, live):
                 in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
                           ).astype(h_blk.dtype) * live
                 local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
-                return (h_blk[local] * in_blk[:, :, None]).sum(axis=1)
+                rows = h_blk[local] * in_blk[:, :, None]
+                return (rows.sum(axis=1),
+                        pair_contract(rows[:, :, F.POD_PROBLEM], pslot,
+                                      pair_width))
 
             if width <= _FOLD_CHUNK:
-                return fold_slice(ev_idx_, slot_live)
+                return fold_slice(ev_idx_, pair_slot_, slot_live)
             def chunk_body(acc, i):
                 sl_i = jax.lax.dynamic_slice_in_dim(
                     ev_idx_, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+                sl_p = jax.lax.dynamic_slice_in_dim(
+                    pair_slot_, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
                 sl_m = jax.lax.dynamic_slice_in_dim(
                     slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-                return acc + fold_slice(sl_i, sl_m), None
-            out, _ = jax.lax.scan(
+                c, pc = fold_slice(sl_i, sl_p, sl_m)
+                return (acc[0] + c, acc[1] + pc), None
+            (c, pc), _ = jax.lax.scan(
                 chunk_body,
-                jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
+                (jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
+                 jnp.zeros((rows_per_shard, pair_width), jnp.float32)),
                 jnp.arange(width // _FOLD_CHUNK))
-            return out
+            return c, pc
 
         def body(r, carry):
-            h_blk, counts, pod_prob = carry
+            h_blk, counts, pair_counts = carry
             src_shard = jnp.mod(my - r, g_size)
             lo = src_shard * nodes_per_shard
-            counts = counts + _fold_block(h_blk, lo)
-            p_in = ((pair_pod_ >= lo) & (pair_pod_ < lo + nodes_per_shard)
-                    ).astype(h_blk.dtype) * pair_mask_
-            p_local = jnp.clip(pair_pod_ - lo, 0, nodes_per_shard - 1)
-            pod_prob = pod_prob + h_blk[p_local, F.POD_PROBLEM] * p_in
+            c, pc = _fold_block(h_blk, lo)
             h_blk = jax.lax.ppermute(h_blk, "graph", _ring_perm(g_size))
-            return h_blk, counts, pod_prob
+            return h_blk, counts + c, pair_counts + pc
 
-        _, counts, pod_prob = jax.lax.fori_loop(
+        _, counts, pair_counts = jax.lax.fori_loop(
             0, g_size, body,
             (blk,
              jnp.zeros((rows_per_shard, blk.shape[1]), jnp.float32),
-             jnp.zeros((pair_pod_.shape[0],), jnp.float32)))
+             jnp.zeros((rows_per_shard, pair_width), jnp.float32)))
 
-        per_pair = jnp.zeros((num_pairs,), jnp.float32
-                             ).at[pair_ids_].add(pod_prob)
-        per_row_max = jnp.zeros((rows_per_shard,), jnp.float32
-                                ).at[pair_rows_].max(per_pair * pair_rows_mask_)
+        per_row_max = pair_counts.max(axis=1)
         return finish_scores(counts, per_row_max, rows_per_shard)
 
     dp_spec = P("dp")
     sharded = shard_map(
         local_score,
         mesh=mesh,
-        in_specs=(P("graph"),                   # feature blocks
-                  dp_spec, dp_spec,             # evidence table
-                  dp_spec, dp_spec, dp_spec,    # pair entries
-                  dp_spec, dp_spec),            # pair rows
+        in_specs=(P("graph"), dp_spec, dp_spec, dp_spec),
         out_specs=tuple([dp_spec] * 7),
         check_vma=False,
     )
@@ -265,7 +203,5 @@ def device_put_graph_sharded(sb: ShardedBatch, mesh: Mesh,
     return (
         jax.device_put(blocks, gsh),
         jax.device_put(sb.ev_idx, dp), jax.device_put(sb.ev_cnt, dp),
-        jax.device_put(sb.pair_ids, dp), jax.device_put(sb.pair_pod, dp),
-        jax.device_put(sb.pair_mask, dp),
-        jax.device_put(sb.pair_rows, dp), jax.device_put(sb.pair_rows_mask, dp),
+        jax.device_put(sb.ev_pair_slot, dp),
     )

@@ -25,16 +25,16 @@ from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_feature
 from ..graph.store import EvidenceGraphStore
 from ..utils.padding import bucket_for
 from .tpu_backend import (
-    DeviceBatch, dense_evidence_table, evidence_coo, pair_tables,
+    _PAIR_WIDTH_BUCKETS, DeviceBatch, dense_evidence_table, evidence_coo,
+    evidence_layout, pair_tables,
 )
 
 _DELTA_BUCKETS = (64, 256, 1024, 4096, 16384)
 
 
-@partial(jax.jit, static_argnames=("padded_incidents", "num_pairs"))
-def _update_and_score(features, idx, rows, ev_idx, ev_cnt, pair_ids,
-                      pair_pod, pair_mask, pair_rows, pair_rows_mask,
-                      chain, padded_incidents: int, num_pairs: int):
+@partial(jax.jit, static_argnames=("padded_incidents", "pair_width"))
+def _update_and_score(features, idx, rows, ev_idx, ev_cnt, ev_pair_slot,
+                      chain, padded_incidents: int, pair_width: int):
     """One fused device call per tick: apply the padded feature delta, then
     score — halves per-tick dispatches vs update-then-score (each dispatch
     costs real latency on a tunneled TPU). The caller replaces its features
@@ -45,8 +45,7 @@ def _update_and_score(features, idx, rows, ev_idx, ev_cnt, pair_ids,
 
     features = features.at[idx].set(rows, mode="drop")
     counts, per_row_max = _aggregate(
-        features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
     counts = counts + jnp.minimum(chain, 0.0)[:, None]
     return (features,) + finish_scores(counts, per_row_max, padded_incidents)
 
@@ -68,13 +67,15 @@ class StreamingScorer:
         # evidence table and its device upload stay resident)
         self._ev_coo = evidence_coo(self.snapshot)
         pi = self.snapshot.padded_incidents
-        ev_idx, ev_cnt = dense_evidence_table(*self._ev_coo, pi)
-        pair = pair_tables(self.snapshot, *self._ev_coo)
+        self._layout = evidence_layout(self._ev_coo[0], pi)
+        ev_idx, ev_cnt = dense_evidence_table(*self._ev_coo, pi,
+                                              layout=self._layout)
+        ev_pair_slot, pair_width = pair_tables(self.snapshot, *self._ev_coo,
+                                               layout=self._layout)
         self._batch = DeviceBatch(
             num_incidents=self.snapshot.num_incidents, padded_incidents=pi,
-            ev_idx=ev_idx, ev_cnt=ev_cnt, pair_ids=pair[0], pair_pod=pair[1],
-            pair_mask=pair[2], pair_rows=pair[3], pair_rows_mask=pair[4],
-            features=self.snapshot.features)
+            ev_idx=ev_idx, ev_cnt=ev_cnt, ev_pair_slot=ev_pair_slot,
+            pair_width=pair_width, features=self.snapshot.features)
         self._ev_args = (jnp.asarray(ev_idx), jnp.asarray(ev_cnt))
         self._pair_args = self._upload_pairs()
         # edge-position index for SCHEDULED_ON retargets: pod idx -> positions
@@ -96,10 +97,7 @@ class StreamingScorer:
         # no block_until_ready: XLA orders the h2d copies before first use,
         # and forcing them costs a ~70 ms sync per structural flush on the
         # dev tunnel
-        return (
-            jnp.asarray(b.pair_ids), jnp.asarray(b.pair_pod), jnp.asarray(b.pair_mask),
-            jnp.asarray(b.pair_rows), jnp.asarray(b.pair_rows_mask),
-        )
+        return (jnp.asarray(b.ev_pair_slot),)
 
     # -- delta ingestion --------------------------------------------------
 
@@ -150,30 +148,36 @@ class StreamingScorer:
 
     def _refresh_pairs(self) -> None:
         # reschedules only retarget SCHEDULED_ON edges: the evidence table
-        # is untouched, so refresh just the five pair arrays
+        # is untouched, so refresh just the pair tables
         from dataclasses import replace
-        pair = pair_tables(self.snapshot, *self._ev_coo)
+        ev_pair_slot, pair_width = pair_tables(self.snapshot, *self._ev_coo,
+                                               layout=self._layout)
         self._batch = replace(
-            self._batch, pair_ids=pair[0], pair_pod=pair[1],
-            pair_mask=pair[2], pair_rows=pair[3], pair_rows_mask=pair[4])
+            self._batch, ev_pair_slot=ev_pair_slot, pair_width=pair_width)
         self._pair_args = self._upload_pairs()
         self._structural_dirty = False
 
     def warm(self, delta_sizes: tuple[int, ...] = (64, 256)) -> None:
         """Pre-compile the fused tick program for the given delta buckets so
         the first real tick doesn't pay a compile (each distinct padded
-        delta size is a distinct XLA program)."""
+        delta size is a distinct XLA program). Also warms the NEXT
+        pair-width bucket: a reschedule spreading one incident's pods onto a
+        new node can bump pair_width mid-stream, and the hot loop must not
+        pay that compile either."""
         pn = self.snapshot.padded_nodes
         dim = self.snapshot.features.shape[1]
         chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
+        cur_w = self._batch.pair_width
+        next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
         for pk in delta_sizes:
             idx = np.full(pk, pn, dtype=np.int32)   # all-dropped delta
             rows = np.zeros((pk, dim), np.float32)
-            out = _update_and_score(
-                self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
-                *self._ev_args, *self._pair_args, chain,
-                padded_incidents=self._batch.padded_incidents,
-                num_pairs=int(self._batch.pair_rows.shape[0]))
+            for pw in {cur_w, next_w}:
+                out = _update_and_score(
+                    self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
+                    *self._ev_args, *self._pair_args, chain,
+                    padded_incidents=self._batch.padded_incidents,
+                    pair_width=pw)
             self._features_dev = out[0]   # no-op update; keep handle fresh
 
     def dispatch(self) -> tuple:
@@ -192,7 +196,7 @@ class StreamingScorer:
             self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
             *self._ev_args, *self._pair_args, chain,
             padded_incidents=self._batch.padded_incidents,
-            num_pairs=int(self._batch.pair_rows.shape[0]),
+            pair_width=self._batch.pair_width,
         )
         self._features_dev = out[0]
         return out[1:]

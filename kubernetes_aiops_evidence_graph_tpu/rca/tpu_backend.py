@@ -54,6 +54,10 @@ _WIDTH_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
 # chunk size for the W-axis fold: bounds the materialized [Pi, chunk, DIM]
 # intermediate so one evidence-heavy incident can't blow up HBM
 _FOLD_CHUNK = 256
+# chunk size for the pair-width axis of the one-hot contraction: bounds the
+# [Pi, chunk, pair_chunk] intermediate when one incident's pods span many
+# nodes (large pair_width buckets would otherwise materialize GiB)
+_PAIR_CHUNK = 64
 
 # Static rule tensors (host constants, baked into the jit closure).
 _RULE_COND = np.zeros((NUM_RULES, NUM_CONDS), dtype=np.float32)
@@ -76,12 +80,14 @@ class DeviceBatch:
     # ev_cnt — shipping the count vector instead of a full mask)
     ev_idx: np.ndarray         # [Pi, W] int32
     ev_cnt: np.ndarray         # [Pi] int32
-    # (incident, node) pair compaction for multiple_pods_same_node
-    pair_ids: np.ndarray       # [Pc] int32 — compact pair index
-    pair_pod: np.ndarray       # [Pc] int32 — pod node index
-    pair_mask: np.ndarray      # [Pc] f32
-    pair_rows: np.ndarray      # [Pp] int32 — incident row per compact pair
-    pair_rows_mask: np.ndarray # [Pp] f32
+    # (incident, node) pairs for multiple_pods_same_node: each evidence slot
+    # carries the row-local id of the node its pod is scheduled on (or
+    # pair_width = "no node"). The device pass turns the ALREADY-GATHERED
+    # evidence rows into per-(row, node) problem-pod counts with one
+    # one-hot contraction — no extra gathers, no scatters (both measured
+    # 0.2-0.7 ms of pure pointer-chasing on v5e-1 at the 50k config).
+    ev_pair_slot: np.ndarray   # [Pi, W] int32, values in [0, pair_width]
+    pair_width: int            # Wr (static): max distinct nodes per row, bucketed
     features: np.ndarray       # [Pn, DIM] f32
 
 
@@ -104,29 +110,60 @@ def evidence_coo(snapshot: GraphSnapshot) -> tuple[np.ndarray, np.ndarray]:
     return inc_row[src[is_ev]], dst[is_ev].astype(np.int64)
 
 
-def dense_evidence_table(ev_rows: np.ndarray, ev_dst: np.ndarray,
-                         pi: int) -> tuple[np.ndarray, np.ndarray]:
-    """[Pi, W] slot table + per-row counts from the COO: sort edges by
-    incident row, place each at its within-row slot (order-stable)."""
+@dataclass(frozen=True)
+class EvidenceLayout:
+    """The shared slot layout of the dense evidence table: the alignment
+    between ev_idx and ev_pair_slot is load-bearing (slot (i, w) must mean
+    the same evidence entry in both), so both tables derive from this one
+    object instead of re-sorting independently."""
+    order: np.ndarray    # permutation sorting the COO by incident row (stable)
+    rows_s: np.ndarray   # sorted incident rows
+    slots: np.ndarray    # within-row slot of each sorted entry
+    cnt: np.ndarray      # per-row entry counts [Pi]
+    width: int           # bucketed max entries per row
+
+
+def evidence_layout(ev_rows: np.ndarray, pi: int) -> EvidenceLayout:
     order = np.argsort(ev_rows, kind="stable")
-    rows_s, dst_s = ev_rows[order], ev_dst[order]
+    rows_s = ev_rows[order]
     cnt = np.bincount(rows_s, minlength=pi) if len(rows_s) else np.zeros(pi, np.int64)
     width = bucket_for(max(int(cnt.max()) if len(rows_s) else 1, 1), _WIDTH_BUCKETS)
-    ev_idx = np.zeros((pi, width), np.int32)
     if len(rows_s):
         starts = np.concatenate([[0], np.cumsum(cnt)])
         slots = np.arange(len(rows_s)) - starts[rows_s]
-        ev_idx[rows_s, slots] = dst_s
-    return ev_idx, cnt.astype(np.int32)
+    else:
+        slots = np.zeros(0, np.int64)
+    return EvidenceLayout(order=order, rows_s=rows_s, slots=slots,
+                          cnt=cnt, width=width)
+
+
+def dense_evidence_table(ev_rows: np.ndarray, ev_dst: np.ndarray, pi: int,
+                         layout: EvidenceLayout | None = None,
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """[Pi, W] slot table + per-row counts from the COO."""
+    lo = layout or evidence_layout(ev_rows, pi)
+    ev_idx = np.zeros((pi, lo.width), np.int32)
+    if len(lo.rows_s):
+        ev_idx[lo.rows_s, lo.slots] = ev_dst[lo.order]
+    return ev_idx, lo.cnt.astype(np.int32)
+
+
+_PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 1024)
 
 
 def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
-                ev_dst: np.ndarray) -> tuple:
-    """(incident, node) pair compaction for multiple_pods_same_node.
+                ev_dst: np.ndarray,
+                layout: EvidenceLayout | None = None) -> tuple[np.ndarray, int]:
+    """Per-evidence-slot pair ids for multiple_pods_same_node.
 
-    Joins incident->pod evidence with pod->node SCHEDULED_ON edges; the
-    only part of the batch that changes on a pod reschedule, so the
-    streaming path refreshes just these five small arrays."""
+    Joins incident->pod evidence with pod->node SCHEDULED_ON edges and
+    assigns each (row, node) pair a ROW-LOCAL id in [0, Wr). Returns
+    ``(ev_pair_slot [Pi, W], Wr)`` aligned with the dense evidence table's
+    slot layout (the SAME EvidenceLayout object — alignment is
+    load-bearing): slot (i, w) holds the local pair id of evidence w's
+    node, or Wr when that evidence is not a pod-on-a-node. The only part of
+    the batch that changes on a pod reschedule, so the streaming path
+    refreshes just this array (reusing its cached layout)."""
     pi = snapshot.padded_incidents
     live = snapshot.edge_mask > 0
     src = snapshot.edge_src[live]
@@ -141,31 +178,29 @@ def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
     node_of_pod = np.full(snapshot.padded_nodes, -1, dtype=np.int64)
     node_of_pod[src[pod_side]] = dst[pod_side]
 
-    on_node = node_of_pod[ev_dst] >= 0
-    pr_rows = ev_rows[on_node]
-    pr_pods = ev_dst[on_node]
-    pr_nodes = node_of_pod[ev_dst[on_node]]
+    lo = layout or evidence_layout(ev_rows, pi)
+    rows_s = lo.rows_s
+    dst_s = ev_dst[lo.order]
 
-    if len(pr_rows):
-        pair_key = pr_rows.astype(np.int64) << 32 | pr_nodes
-        uniq, pair_ids = np.unique(pair_key, return_inverse=True)
-        pair_rows_real = (uniq >> 32).astype(np.int32)
+    node_s = node_of_pod[dst_s] if len(dst_s) else dst_s
+    on_node = node_s >= 0
+    if on_node.any():
+        pair_key = rows_s[on_node].astype(np.int64) << 32 | node_s[on_node]
+        uniq, inv = np.unique(pair_key, return_inverse=True)
+        pair_row = (uniq >> 32).astype(np.int64)
+        per_row = np.bincount(pair_row, minlength=pi)
+        wr = bucket_for(max(int(per_row.max()), 1), _PAIR_WIDTH_BUCKETS)
+        starts_r = np.concatenate([[0], np.cumsum(per_row)])
+        local_of_pair = np.arange(len(uniq)) - starts_r[pair_row]
     else:
-        pair_ids = np.zeros(0, dtype=np.int64)
-        pair_rows_real = np.zeros(0, dtype=np.int32)
+        local_of_pair = np.zeros(0, np.int64)
+        inv = np.zeros(0, np.int64)
+        wr = _PAIR_WIDTH_BUCKETS[0]
 
-    pc = bucket_for(max(len(pr_rows), 1), _EDGE_BUCKETS)
-    pp = bucket_for(max(len(pair_rows_real), 1), _EDGE_BUCKETS)
-
-    def _pad(arr, size, fill=0):
-        out = np.full(size, fill, dtype=np.int32)
-        out[:len(arr)] = arr
-        return out
-
-    pair_mask = np.zeros(pc, np.float32); pair_mask[:len(pr_rows)] = 1.0
-    pair_rows_mask = np.zeros(pp, np.float32); pair_rows_mask[:len(pair_rows_real)] = 1.0
-    return (_pad(pair_ids, pc, fill=pp - 1), _pad(pr_pods, pc), pair_mask,
-            _pad(pair_rows_real, pp, fill=pi - 1), pair_rows_mask)
+    ev_pair_slot = np.full((pi, lo.width), wr, dtype=np.int32)  # wr = "no node"
+    if len(rows_s) and on_node.any():
+        ev_pair_slot[rows_s[on_node], lo.slots[on_node]] = local_of_pair[inv]
+    return ev_pair_slot, wr
 
 
 def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
@@ -173,25 +208,41 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     pi = snapshot.padded_incidents
     ev_rows, ev_dst = evidence_coo(snapshot)
     ev_idx, ev_cnt = dense_evidence_table(ev_rows, ev_dst, pi)
-    pair_ids, pair_pod, pair_mask, pair_rows, pair_rows_mask = pair_tables(
-        snapshot, ev_rows, ev_dst)
+    ev_pair_slot, pair_width = pair_tables(snapshot, ev_rows, ev_dst)
     return DeviceBatch(
         num_incidents=snapshot.num_incidents,
         padded_incidents=pi,
         ev_idx=ev_idx,
         ev_cnt=ev_cnt,
-        pair_ids=pair_ids,
-        pair_pod=pair_pod,
-        pair_mask=pair_mask,
-        pair_rows=pair_rows,
-        pair_rows_mask=pair_rows_mask,
+        ev_pair_slot=ev_pair_slot,
+        pair_width=pair_width,
         features=snapshot.features,
     )
 
 
-def _aggregate(features, ev_idx, ev_cnt, pair_ids, pair_pod,
-               pair_mask, pair_rows, pair_rows_mask,
-               padded_incidents: int, num_pairs: int):
+def pair_contract(problem: jax.Array, pslot: jax.Array,
+                  pair_width: int) -> jax.Array:
+    """[Pi, C] problem flags × per-slot pair ids → [Pi, pair_width] counts.
+
+    One-hot contraction, chunked on the pair axis so the materialized
+    [Pi, C, _PAIR_CHUNK] intermediate stays bounded at any pair_width.
+    Out-of-range ids (the "no node" sentinel, or ids outside the current
+    chunk) one-hot to zero rows and drop out."""
+    if pair_width <= _PAIR_CHUNK:
+        onehot = jax.nn.one_hot(pslot, pair_width, dtype=problem.dtype)
+        return jnp.einsum("ic,icr->ir", problem, onehot)
+
+    def body(_, r0):
+        oh = jax.nn.one_hot(pslot - r0, _PAIR_CHUNK, dtype=problem.dtype)
+        return None, jnp.einsum("ic,icr->ir", problem, oh)
+
+    _, chunks = jax.lax.scan(
+        body, None, jnp.arange(0, pair_width, _PAIR_CHUNK))
+    return jnp.moveaxis(chunks, 0, 1).reshape(problem.shape[0], pair_width)
+
+
+def _aggregate(features, ev_idx, ev_cnt, ev_pair_slot,
+               padded_incidents: int, pair_width: int):
     """Evidence fold shared by the XLA and Pallas scoring paths."""
     # fold evidence features per incident: dense gather + masked sum over
     # the static slot axis (no scatter — TPU scatter-add with duplicate
@@ -199,64 +250,67 @@ def _aggregate(features, ev_idx, ev_cnt, pair_ids, pair_pod,
     # Live slots are a contiguous prefix, so the mask is derived on device
     # from the count vector; wide tables fold in _FOLD_CHUNK slices so the
     # [Pi, chunk, DIM] intermediate stays bounded under per-incident skew.
+    #
+    # multiple-pods-same-node rides the SAME gathered rows: each slot's
+    # row-local pair id one-hots into [chunk, Wr] and contracts with the
+    # slot's POD_PROBLEM flag — per-(row, node) problem-pod counts with
+    # zero extra gathers (gather/scatter pair formulations measured
+    # 0.2-0.7 ms of pointer-chasing on v5e-1; this adds ~nothing).
     width = ev_idx.shape[1]
 
-    def _fold(idx, base):
+    def _fold(idx, pair_slot, base):
         m = (base + jax.lax.broadcasted_iota(jnp.int32, idx.shape, 1)
              < ev_cnt[:, None]).astype(features.dtype)
-        return (features[idx] * m[:, :, None]).sum(axis=1)           # [Pi, DIM]
+        rows = features[idx] * m[:, :, None]                         # [Pi, C, DIM]
+        counts = rows.sum(axis=1)                                    # [Pi, DIM]
+        pair_counts = pair_contract(rows[:, :, F.POD_PROBLEM],
+                                    pair_slot, pair_width)           # [Pi, Wr]
+        return counts, pair_counts
 
     if width <= _FOLD_CHUNK:
-        counts = _fold(ev_idx, 0)
+        counts, pair_counts = _fold(ev_idx, ev_pair_slot, 0)
     else:
         def body(acc, i):
             sl = jax.lax.dynamic_slice_in_dim(ev_idx, i * _FOLD_CHUNK,
                                               _FOLD_CHUNK, axis=1)
-            return acc + _fold(sl, i * _FOLD_CHUNK), None
-        counts, _ = jax.lax.scan(
-            body, jnp.zeros((padded_incidents, features.shape[1]), jnp.float32),
+            ps = jax.lax.dynamic_slice_in_dim(ev_pair_slot, i * _FOLD_CHUNK,
+                                              _FOLD_CHUNK, axis=1)
+            c, pc = _fold(sl, ps, i * _FOLD_CHUNK)
+            return (acc[0] + c, acc[1] + pc), None
+        (counts, pair_counts), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((padded_incidents, features.shape[1]), jnp.float32),
+             jnp.zeros((padded_incidents, pair_width), jnp.float32)),
             jnp.arange(width // _FOLD_CHUNK))
-    # multiple-pods-same-node: per (incident,node) problem-pod count,
-    # then per-incident max
-    problem = features[:, F.POD_PROBLEM][pair_pod] * pair_mask       # [Pc]
-    per_pair = jnp.zeros((num_pairs,), jnp.float32).at[pair_ids].add(problem)
-    per_row_max = jnp.zeros((padded_incidents,), jnp.float32
-                            ).at[pair_rows].max(per_pair * pair_rows_mask)
+    per_row_max = pair_counts.max(axis=1)                            # [Pi]
     return counts, per_row_max
 
 
-@partial(jax.jit, static_argnames=("padded_incidents", "num_pairs", "interpret"))
+@partial(jax.jit, static_argnames=("padded_incidents", "pair_width", "interpret"))
 def _score_device_pallas(
-    features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-    pair_rows, pair_rows_mask, chain, padded_incidents: int, num_pairs: int,
-    interpret: bool = False,
+    features, ev_idx, ev_cnt, ev_pair_slot, chain, padded_incidents: int,
+    pair_width: int, interpret: bool = False,
 ):
     """Aggregation + the fused Pallas rules kernel (ops/pallas_rules.py)."""
     from ..ops.pallas_rules import fused_rules_engine
     counts, per_row_max = _aggregate(
-        features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
     counts = counts + jnp.minimum(chain, 0.0)[:, None]  # see dispatch()
     return fused_rules_engine(counts, per_row_max, interpret=interpret)
 
 
-@partial(jax.jit, static_argnames=("padded_incidents", "num_pairs"))
+@partial(jax.jit, static_argnames=("padded_incidents", "pair_width"))
 def _score_device(
     features: jax.Array,       # [Pn, DIM]
     ev_idx: jax.Array,         # [Pi, W]
     ev_cnt: jax.Array,         # [Pi]
-    pair_ids: jax.Array,       # [Pc]
-    pair_pod: jax.Array,       # [Pc]
-    pair_mask: jax.Array,      # [Pc]
-    pair_rows: jax.Array,      # [Pp]
-    pair_rows_mask: jax.Array, # [Pp]
+    ev_pair_slot: jax.Array,   # [Pi, W]
     chain: jax.Array,          # [Pi] — see dispatch()
     padded_incidents: int,
-    num_pairs: int,
+    pair_width: int,
 ):
     counts, per_row_max = _aggregate(
-        features, ev_idx, ev_cnt, pair_ids, pair_pod, pair_mask,
-        pair_rows, pair_rows_mask, padded_incidents, num_pairs)
+        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
     counts = counts + jnp.minimum(chain, 0.0)[:, None]
     return finish_scores(counts, per_row_max, padded_incidents)
 
@@ -335,9 +389,7 @@ class TpuRcaBackend:
         args = (
             jnp.asarray(batch.features),
             jnp.asarray(batch.ev_idx), jnp.asarray(batch.ev_cnt),
-            jnp.asarray(batch.pair_ids), jnp.asarray(batch.pair_pod),
-            jnp.asarray(batch.pair_mask),
-            jnp.asarray(batch.pair_rows), jnp.asarray(batch.pair_rows_mask),
+            jnp.asarray(batch.ev_pair_slot),
         )
         self._cached_snapshot, self._batch, self._device_args = snapshot, batch, args
         return batch, args, time.perf_counter() - t0
@@ -364,13 +416,13 @@ class TpuRcaBackend:
             return _score_device_pallas(
                 *args, chain,
                 padded_incidents=batch.padded_incidents,
-                num_pairs=int(batch.pair_rows.shape[0]),
+                pair_width=batch.pair_width,
                 interpret=jax.default_backend() != "tpu",
             )
         return _score_device(
             *args, chain,
             padded_incidents=batch.padded_incidents,
-            num_pairs=int(batch.pair_rows.shape[0]),
+            pair_width=batch.pair_width,
         )
 
     def prepared(self, snapshot: GraphSnapshot) -> DeviceBatch:

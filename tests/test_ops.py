@@ -60,11 +60,32 @@ def test_wide_evidence_fold_uses_chunked_path():
 
     counts, _ = tb._aggregate(
         jnp.asarray(features), jnp.asarray(ev_idx), jnp.asarray(ev_cnt),
-        jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.float32),
-        jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.float32),
-        padded_incidents=pi, num_pairs=4)
+        jnp.full(ev_idx.shape, 4, jnp.int32),   # all slots: no pair
+        padded_incidents=pi, pair_width=4)
 
     expected = np.zeros((pi, DIM), np.float32)
     for r in range(pi):
         expected[r] = features[ev_idx[r, :ev_cnt[r]]].sum(axis=0)
     np.testing.assert_allclose(np.asarray(counts), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_pair_contract_chunked_matches_direct():
+    """pair_width > _PAIR_CHUNK must route through the bounded Wr-chunk
+    scan and match a direct numpy contraction."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.tpu_backend import (
+        _PAIR_CHUNK, pair_contract,
+    )
+
+    rng = np.random.default_rng(1)
+    pi, c = 8, 32
+    wr = 2 * _PAIR_CHUNK
+    problem = rng.random((pi, c)).astype(np.float32)
+    pslot = rng.integers(0, wr + 1, (pi, c)).astype(np.int32)  # wr = sentinel
+
+    out = np.asarray(pair_contract(jnp.asarray(problem), jnp.asarray(pslot), wr))
+    expected = np.zeros((pi, wr), np.float32)
+    for i in range(pi):
+        for j in range(c):
+            if pslot[i, j] < wr:
+                expected[i, pslot[i, j]] += problem[i, j]
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)

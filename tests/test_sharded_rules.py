@@ -53,8 +53,7 @@ def test_sharded_scoring_matches_single_device(dp):
     mesh = make_mesh(dp=dp, graph=1, devices=jax.devices()[:dp])
     sb = shard_batch(batch, dp)
     args = device_put_sharded_batch(sb, mesh)
-    score = make_sharded_score(mesh, sb.rows_per_shard,
-                               num_pairs=int(sb.pair_rows.shape[1]))
+    score = make_sharded_score(mesh, sb.rows_per_shard, sb.pair_width)
     conds, matched, scores, top_idx, any_match, top_conf, top_score = (
         jax.device_get(score(*args)))
 
@@ -90,8 +89,9 @@ def test_graph_sharded_scoring_matches_single_device(dp, graph):
     sb = shard_batch(batch, dp)
     args = device_put_graph_sharded(sb, mesh, graph)
     score = make_graph_sharded_score(
-        mesh, sb.rows_per_shard, num_pairs=int(sb.pair_rows.shape[1]),
-        nodes_per_shard=snap.padded_nodes // graph)
+        mesh, sb.rows_per_shard,
+        nodes_per_shard=snap.padded_nodes // graph,
+        pair_width=sb.pair_width)
     conds, matched, scores, top_idx, any_match, top_conf, top_score = (
         jax.device_get(score(*args)))
 
