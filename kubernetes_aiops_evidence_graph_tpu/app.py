@@ -39,6 +39,15 @@ class AiopsApp:
         self.cluster = cluster
         self.db = db or Database(self.settings.db_path)
         self.builder = GraphBuilder()
+        if self.settings.graph_persist_path:
+            import os
+            if os.path.exists(self.settings.graph_persist_path):
+                from .graph.store import EvidenceGraphStore
+                self.builder.store = EvidenceGraphStore.load(
+                    self.settings.graph_persist_path)
+                log.info("graph_restored",
+                         path=self.settings.graph_persist_path,
+                         nodes=self.builder.store.node_count())
         self.store = self.builder.store
         self.dedup = AlertDeduplicator(self.settings)
         self.rate_limiter = RateLimiter(self.settings)
@@ -82,7 +91,16 @@ class AiopsApp:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=5)
             self._loop = None
-        self.db.close()
+        try:
+            if self.settings.graph_persist_path:
+                written = self.store.save(self.settings.graph_persist_path)
+                log.info("graph_persisted",
+                         path=self.settings.graph_persist_path,
+                         records=written)
+        except Exception as exc:   # never let persistence block shutdown
+            log.error("graph_persist_failed", error=str(exc))
+        finally:
+            self.db.close()
 
     def ready(self) -> bool:
         try:

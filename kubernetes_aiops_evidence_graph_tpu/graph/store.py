@@ -314,3 +314,65 @@ class EvidenceGraphStore:
             nodes = sorted(self._nodes.values(), key=lambda n: n.index)
             edges = list(self._edges.values())
         return nodes, edges
+
+    # -- persistence (the Neo4j-durability analog; settings.graph_persist_path)
+
+    def save(self, path: str) -> int:
+        """Dump the graph as JSON lines (one node/edge per line) via an
+        atomic rename. Returns the number of records written."""
+        import json
+        import os
+        import tempfile
+
+        nodes, edges = self._raw()
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        n = 0
+        try:
+            with os.fdopen(fd, "w") as f:
+                for node in nodes:
+                    f.write(json.dumps({
+                        "t": "n", "id": node.id, "label": node.label,
+                        "properties": node.properties,
+                    }, default=str) + "\n")
+                    n += 1
+                for edge in edges:
+                    f.write(json.dumps({
+                        "t": "e", "src": edge.src, "dst": edge.dst,
+                        "kind": edge.kind.name, "properties": edge.properties,
+                    }, default=str) + "\n")
+                    n += 1
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return n
+
+    @classmethod
+    def load(cls, path: str) -> "EvidenceGraphStore":
+        """Rebuild a store from a save() dump (insertion order preserved,
+        so node indices — and therefore snapshots — are reproducible)."""
+        import json
+
+        store = cls()
+        entities: list[GraphEntity] = []
+        relations: list[GraphRelation] = []
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["t"] == "n":
+                    entities.append(GraphEntity(
+                        id=rec["id"], type=rec["label"],
+                        properties=rec["properties"]))
+                else:
+                    relations.append(GraphRelation(
+                        source_id=rec["src"], target_id=rec["dst"],
+                        relation_type=rec["kind"],
+                        properties=rec["properties"]))
+        store.upsert_entities(entities)
+        store.upsert_relations(relations)
+        return store

@@ -152,6 +152,10 @@ class StreamingScorer:
         from dataclasses import replace
         ev_pair_slot, pair_width = pair_tables(self.snapshot, *self._ev_coo,
                                                layout=self._layout)
+        # never SHRINK pair_width mid-stream: a smaller bucket would be a
+        # program warm() hasn't compiled (shrinking only wastes padding;
+        # the sentinel stays out of range either way)
+        pair_width = max(pair_width, self._batch.pair_width)
         self._batch = replace(
             self._batch, ev_pair_slot=ev_pair_slot, pair_width=pair_width)
         self._pair_args = self._upload_pairs()

@@ -148,7 +148,7 @@ def dense_evidence_table(ev_rows: np.ndarray, ev_dst: np.ndarray, pi: int,
     return ev_idx, lo.cnt.astype(np.int32)
 
 
-_PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 1024)
+_PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
@@ -207,8 +207,11 @@ def prepare_batch(snapshot: GraphSnapshot) -> DeviceBatch:
     """Host-side O(E) prep from a snapshot (pure numpy)."""
     pi = snapshot.padded_incidents
     ev_rows, ev_dst = evidence_coo(snapshot)
-    ev_idx, ev_cnt = dense_evidence_table(ev_rows, ev_dst, pi)
-    ev_pair_slot, pair_width = pair_tables(snapshot, ev_rows, ev_dst)
+    layout = evidence_layout(ev_rows, pi)   # ONE layout for both tables:
+    # the ev_idx/ev_pair_slot slot alignment is load-bearing
+    ev_idx, ev_cnt = dense_evidence_table(ev_rows, ev_dst, pi, layout=layout)
+    ev_pair_slot, pair_width = pair_tables(snapshot, ev_rows, ev_dst,
+                                           layout=layout)
     return DeviceBatch(
         num_incidents=snapshot.num_incidents,
         padded_incidents=pi,

@@ -102,3 +102,38 @@ def test_duplicate_webhook_is_deduplicated(served):
     assert len(first["created"]) == 1
     assert out["created"] == []
     assert out["duplicates"] == 1
+
+
+def test_graph_persistence_across_restart(tmp_path):
+    """graph_persist_path: the evidence graph survives an app restart
+    (the Neo4j-durability analog)."""
+    cluster = generate_cluster(num_pods=64, seed=1)
+    inject(cluster, "oom", "default/svc-0", np.random.default_rng(1))
+    gpath = str(tmp_path / "graph.jsonl")
+    settings = load_settings(
+        api_port=0, db_path=":memory:", app_env="development",
+        remediation_dry_run=False, verification_wait_seconds=0,
+        rca_backend="cpu", graph_persist_path=gpath,
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+
+    app = AiopsApp(cluster, settings)
+    port = app.start(host="127.0.0.1")
+    base = f"http://127.0.0.1:{port}"
+    alert = json.loads(json.dumps(ALERT))
+    alert["alerts"][0]["labels"]["alertname"] = "OOMPersist"
+    iid = _post(base, "/api/v1/webhooks/alertmanager", alert)["created"][0]
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if _get(base, f"/api/v1/incidents/{iid}/status").get("state") == "completed":
+            break
+        time.sleep(0.25)
+    nodes_before = app.store.node_count()
+    assert nodes_before > 1
+    app.stop()
+
+    app2 = AiopsApp(cluster, settings)
+    assert app2.store.node_count() == nodes_before
+    sub = app2.store.get_incident_subgraph(f"incident:{iid}", depth=3)
+    assert len(sub["nodes"]) > 1
+    app2.db.close()

@@ -151,3 +151,47 @@ def test_builder_ingest_applies_evidence():
     # AFFECTS edge auto-created incident -> pod
     src, dst = snap.typed_edges(RelationKind.AFFECTS)
     assert len(src) == 2
+
+
+def test_store_save_load_roundtrip(tmp_path):
+    """graph_persist_path durability: a reloaded store must reproduce the
+    same subgraphs and tensorized snapshots (insertion order preserved)."""
+    import numpy as np
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors,
+    )
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder, build_snapshot
+    from kubernetes_aiops_evidence_graph_tpu.graph.store import EvidenceGraphStore
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+    from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+    settings = load_settings(
+        node_bucket_sizes=(256, 512), edge_bucket_sizes=(1024, 4096),
+        incident_bucket_sizes=(8,))
+    cluster = generate_cluster(num_pods=48, seed=7)
+    rng = np.random.default_rng(7)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    inc = inject(cluster, "oom", sorted(cluster.deployments)[0], rng)
+    builder.ingest(inc, collect_all(inc, default_collectors(cluster, settings),
+                                    parallel=False))
+
+    path = str(tmp_path / "graph.jsonl")
+    written = builder.store.save(path)
+    assert written == builder.store.node_count() + builder.store.edge_count()
+
+    restored = EvidenceGraphStore.load(path)
+    assert restored.node_count() == builder.store.node_count()
+    assert restored.edge_count() == builder.store.edge_count()
+    inc_node = f"incident:{inc.id}"
+    a = builder.store.get_incident_subgraph(inc_node, depth=3)
+    b = restored.get_incident_subgraph(inc_node, depth=3)
+    assert {n["id"] for n in a["nodes"]} == {n["id"] for n in b["nodes"]}
+
+    now = cluster.now.timestamp()
+    sa = build_snapshot(builder.store, settings, now_s=now)
+    sb = build_snapshot(restored, settings, now_s=now)
+    assert sa.node_ids == sb.node_ids
+    np.testing.assert_array_equal(sa.features, sb.features)
+    np.testing.assert_array_equal(sa.edge_src, sb.edge_src)
