@@ -8,15 +8,18 @@ graph:
 1. host prep (numpy, O(E)): evidence edges (Incident→entity AFFECTS /
    CORRELATES_WITH) labeled with their incident *row* and laid out as a
    dense bucketed [Pi, W] slot table (sorted by row; W = bucketed max
-   evidence per incident); a hash join of AFFECTS(incident→pod) with
-   SCHEDULED_ON(pod→node) into compact (row, node) pair ids for the
-   multiple-pods-same-node condition;
+   evidence per incident); a join of AFFECTS(incident→pod) with
+   SCHEDULED_ON(pod→node) stamps each slot with a row-local pair id for
+   the multiple-pods-same-node condition (same slot layout, see
+   EvidenceLayout);
 2. device (jit, static shapes): the evidence fold is a dense gather +
    sum over the static W axis — no scatter at all (TPU scatter-add with
    duplicate indices serializes; the dense fold measured 4× faster at the
-   50k-node config) — then condition vector = thresholded counts; rule
-   matching = one [C]×[R,C] contraction; confidence/rank collapse to
-   constant-folded per-rule scores (see ruleset.py) so top-1 is an argmax.
+   50k-node config) — and the per-(row, node) problem-pod counts ride the
+   same gathered rows as a chunked one-hot contraction (pair_contract);
+   then condition vector = thresholded counts; rule matching = one
+   [C]×[R,C] contraction; confidence/rank collapse to constant-folded
+   per-rule scores (see ruleset.py) so top-1 is an argmax.
 
 Because the signal fold and checkers mirror the CPU oracle exactly, top-1
 rule ids and scores are bit-identical — enforced by the parity tests.

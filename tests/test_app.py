@@ -137,3 +137,37 @@ def test_graph_persistence_across_restart(tmp_path):
     sub = app2.store.get_incident_subgraph(f"incident:{iid}", depth=3)
     assert len(sub["nodes"]) > 1
     app2.db.close()
+
+
+def test_concurrent_webhooks_all_complete(served):
+    """The threaded HTTP server + single worker loop must absorb parallel
+    webhook bursts without losing or duplicating incidents."""
+    import concurrent.futures
+
+    app, base = served
+    n = 12
+
+    def fire(i):
+        alert = json.loads(json.dumps(ALERT))
+        alert["alerts"][0]["labels"]["alertname"] = f"Burst{i}"
+        alert["alerts"][0]["labels"]["service"] = "svc-0"
+        return _post(base, "/api/v1/webhooks/alertmanager", alert)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        results = list(ex.map(fire, range(n)))
+    created = [iid for r in results for iid in r["created"]]
+    assert len(created) == n            # distinct alertnames -> no dedup
+    assert len(set(created)) == n
+
+    deadline = time.monotonic() + 180
+    pending = set(created)
+    while pending and time.monotonic() < deadline:
+        for iid in list(pending):
+            st = _get(base, f"/api/v1/incidents/{iid}/status").get("state")
+            if st in ("completed", "failed"):
+                pending.discard(iid)
+        time.sleep(0.25)
+    assert not pending, f"{len(pending)} workflows never finished"
+    for iid in created:
+        st = _get(base, f"/api/v1/incidents/{iid}/status")["state"]
+        assert st == "completed"
