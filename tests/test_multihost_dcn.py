@@ -1,0 +1,91 @@
+"""Real multi-process DCN test: two OS processes form a JAX process group
+via parallel/multihost.py and run a cross-host psum + a multihost-mesh
+sharded scoring pass. This exercises the actual jax.distributed wiring the
+single-process tests can't (SURVEY.md §2.4 distributed backend).
+
+Each child gets 2 virtual CPU devices → global mesh (dp=2 hosts × graph=2).
+"""
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+
+import pytest
+
+CHILD = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.parallel.multihost import (
+    host_local_incident_slice, init_distributed, make_multihost_mesh,
+)
+
+assert init_distributed(), "process group did not form"
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+
+mesh = make_multihost_mesh(graph_per_host=2)
+assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "graph": 2}
+
+# cross-host collective: psum over dp must see every host's contribution
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+pid = jax.process_index()
+
+def tot(x):
+    return jax.lax.psum(x, "dp")[None]
+
+f = jax.jit(shard_map(tot, mesh=mesh, in_specs=P("dp"),
+                      out_specs=P("dp"), check_vma=False))
+# global [2] array, row h = h+1 (host-major order)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), np.asarray([float(pid + 1)]), (2,))
+out = f(arr)
+total = float(jax.device_get(out.addressable_shards[0].data)[0])
+assert total == 3.0, total   # 1 + 2 over DCN
+
+sl = host_local_incident_slice(10)
+assert (sl.start, sl.stop) == ((0, 5) if pid == 0 else (5, 10)), sl
+
+print(f"child{pid}: psum={total} slice={sl.start}:{sl.stop} OK", flush=True)
+"""
+
+
+def test_two_process_group_psum_over_dcn(tmp_path):
+    with socket.socket() as s:   # find a free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for pid in range(2):
+        env = {
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/root",
+            "KAEG_COORDINATOR": f"127.0.0.1:{port}",
+            "KAEG_NUM_PROCESSES": "2",
+            "KAEG_PROCESS_ID": str(pid),
+            "PYTHONPATH": "/root/repo",
+        }
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", CHILD], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost children timed out\n" + "\n".join(outs))
+
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child{pid} failed:\n{out}"
+        assert f"child{pid}: psum=3.0" in out, out
