@@ -256,6 +256,26 @@ class ApiHandler(BaseHTTPRequestHandler):
         self._json(200 if ok else 404,
                    {"resolved": ok, "action_id": action_id})
 
+    # -- hypothesis feedback (the reference defines HypothesisFeedback but
+    #    never persists or accepts it — hypothesis.py:169-176) -------------
+
+    @route("POST", r"/api/v1/hypotheses/(?P<hypothesis_id>[0-9a-f-]+)/feedback")
+    def submit_feedback(self, hypothesis_id: str):
+        from ..models import HypothesisFeedback
+        body = self._body()
+        try:
+            fb = HypothesisFeedback(hypothesis_id=hypothesis_id, **body)
+        except Exception as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        self.app.db.insert_feedback(fb)
+        self._json(201, {"recorded": True,
+                         "hypothesis_id": str(fb.hypothesis_id)})
+
+    @route("GET", r"/api/v1/hypotheses/(?P<hypothesis_id>[0-9a-f-]+)/feedback")
+    def list_feedback(self, hypothesis_id: str):
+        self._json(200, {"feedback": self.app.db.feedback_for(hypothesis_id)})
+
     # -- traces (observability; new) --------------------------------------
 
     @route("GET", "/api/v1/traces")

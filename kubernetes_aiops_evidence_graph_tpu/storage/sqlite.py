@@ -137,6 +137,15 @@ CREATE TABLE IF NOT EXISTS runbooks (
     generated_at TEXT NOT NULL
 );
 
+CREATE TABLE IF NOT EXISTS hypothesis_feedback (
+    hypothesis_id TEXT NOT NULL,
+    was_correct INTEGER NOT NULL,
+    actual_root_cause TEXT,
+    feedback_notes TEXT,
+    submitted_by TEXT NOT NULL DEFAULT 'unknown',
+    submitted_at TEXT NOT NULL
+);
+
 CREATE TABLE IF NOT EXISTS workflow_journal (
     workflow_id TEXT NOT NULL,
     step TEXT NOT NULL,
@@ -179,8 +188,12 @@ class Database:
         self.path = path
         self._local = threading.local()
         self._lock = threading.RLock()
+        # unique per instance: a fixed name would alias every ":memory:"
+        # Database in the process onto one shared-cache DB (cross-instance
+        # lock collisions; latent bug found via concurrent API tests)
         self._memory_uri = (
-            "file:kaeg_mem?mode=memory&cache=shared" if path == ":memory:" else None
+            f"file:kaeg_mem_{id(self)}?mode=memory&cache=shared"
+            if path == ":memory:" else None
         )
         # keep one anchoring connection so a shared in-memory DB survives
         self._anchor = self._connect()
@@ -332,6 +345,25 @@ class Database:
                 "SELECT * FROM hypotheses WHERE incident_id=? ORDER BY rank",
                 (str(incident_id),))
         ]
+
+    def insert_feedback(self, fb) -> None:
+        """Record operator feedback on a hypothesis (HypothesisFeedback —
+        the model the reference defines but never persists,
+        hypothesis.py:169-176)."""
+        with self._lock:
+            self.conn.execute(
+                "INSERT INTO hypothesis_feedback (hypothesis_id, was_correct,"
+                " actual_root_cause, feedback_notes, submitted_by,"
+                " submitted_at) VALUES (?,?,?,?,?,?)",
+                (str(fb.hypothesis_id), int(fb.was_correct),
+                 fb.actual_root_cause, fb.feedback_notes, fb.submitted_by,
+                 fb.submitted_at.isoformat()))
+            self.conn.commit()
+
+    def feedback_for(self, hypothesis_id: UUID | str) -> list[dict]:
+        return [dict(r) for r in self.query(
+            "SELECT * FROM hypothesis_feedback WHERE hypothesis_id=?"
+            " ORDER BY submitted_at", (str(hypothesis_id),))]
 
     # -- actions / verifications / runbooks ------------------------------
 

@@ -171,3 +171,39 @@ def test_concurrent_webhooks_all_complete(served):
     for iid in created:
         st = _get(base, f"/api/v1/incidents/{iid}/status")["state"]
         assert st == "completed"
+
+
+def test_hypothesis_feedback_roundtrip(served):
+    """POST/GET feedback on a hypothesis — the HypothesisFeedback surface
+    the reference models but never persists (hypothesis.py:169-176)."""
+    app, base = served
+    alert = json.loads(json.dumps(ALERT))
+    alert["alerts"][0]["labels"]["alertname"] = "FeedbackCase"
+    iid = _post(base, "/api/v1/webhooks/alertmanager", alert)["created"][0]
+    deadline = time.monotonic() + 120
+    hyps = []
+    while time.monotonic() < deadline:
+        hyps = _get(base, f"/api/v1/incidents/{iid}/hypotheses")["hypotheses"]
+        if hyps:
+            break
+        time.sleep(0.25)
+    assert hyps
+    hid = hyps[0]["id"]
+
+    out = _post(base, f"/api/v1/hypotheses/{hid}/feedback",
+                {"was_correct": True, "submitted_by": "sre-alice",
+                 "feedback_notes": "rollback fixed it"})
+    assert out["recorded"] is True
+    fb = _get(base, f"/api/v1/hypotheses/{hid}/feedback")["feedback"]
+    assert len(fb) == 1
+    assert fb[0]["was_correct"] == 1
+    assert fb[0]["submitted_by"] == "sre-alice"
+
+    # malformed body -> 400, nothing stored
+    import urllib.error
+    try:
+        _post(base, f"/api/v1/hypotheses/{hid}/feedback", {"bogus": 1})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    assert len(_get(base, f"/api/v1/hypotheses/{hid}/feedback")["feedback"]) == 1
