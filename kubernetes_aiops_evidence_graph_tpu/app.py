@@ -41,13 +41,24 @@ class AiopsApp:
         self.builder = GraphBuilder()
         if self.settings.graph_persist_path:
             import os
-            if os.path.exists(self.settings.graph_persist_path):
+            path = self.settings.graph_persist_path
+            if os.path.exists(path):
                 from .graph.store import EvidenceGraphStore
-                self.builder.store = EvidenceGraphStore.load(
-                    self.settings.graph_persist_path)
-                log.info("graph_restored",
-                         path=self.settings.graph_persist_path,
-                         nodes=self.builder.store.node_count())
+                # a corrupt/incompatible persist file must not block startup
+                # (stop() likewise never lets persistence failures block
+                # shutdown) — move it aside and start with an empty store
+                try:
+                    self.builder.store = EvidenceGraphStore.load(path)
+                    log.info("graph_restored", path=path,
+                             nodes=self.builder.store.node_count())
+                except Exception as exc:
+                    bad = path + ".corrupt"
+                    try:
+                        os.replace(path, bad)
+                    except OSError:
+                        bad = "<unmovable>"
+                    log.error("graph_restore_failed", path=path,
+                              moved_to=bad, error=str(exc))
         self.store = self.builder.store
         self.dedup = AlertDeduplicator(self.settings)
         self.rate_limiter = RateLimiter(self.settings)

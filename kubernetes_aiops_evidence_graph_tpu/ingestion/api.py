@@ -268,7 +268,10 @@ class ApiHandler(BaseHTTPRequestHandler):
         except Exception as exc:
             self._json(400, {"error": str(exc)})
             return
-        self.app.db.insert_feedback(fb)
+        if not self.app.db.insert_feedback(fb):
+            self._json(404, {"error": "unknown hypothesis",
+                             "hypothesis_id": str(fb.hypothesis_id)})
+            return
         self._json(201, {"recorded": True,
                          "hypothesis_id": str(fb.hypothesis_id)})
 

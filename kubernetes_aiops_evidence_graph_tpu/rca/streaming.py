@@ -150,12 +150,14 @@ class StreamingScorer:
         # reschedules only retarget SCHEDULED_ON edges: the evidence table
         # is untouched, so refresh just the pair tables
         from dataclasses import replace
-        ev_pair_slot, pair_width = pair_tables(self.snapshot, *self._ev_coo,
-                                               layout=self._layout)
         # never SHRINK pair_width mid-stream: a smaller bucket would be a
-        # program warm() hasn't compiled (shrinking only wastes padding;
-        # the sentinel stays out of range either way)
-        pair_width = max(pair_width, self._batch.pair_width)
+        # program warm() hasn't compiled. The floor goes INTO pair_tables so
+        # the "no node" sentinel is stamped with the clamped width — a
+        # sentinel stamped with a smaller, unclamped width would land in
+        # range of the wider compiled one_hot and count phantom pods.
+        ev_pair_slot, pair_width = pair_tables(
+            self.snapshot, *self._ev_coo, layout=self._layout,
+            min_width=self._batch.pair_width)
         self._batch = replace(
             self._batch, ev_pair_slot=ev_pair_slot, pair_width=pair_width)
         self._pair_args = self._upload_pairs()
@@ -168,11 +170,14 @@ class StreamingScorer:
         pair-width bucket: a reschedule spreading one incident's pods onto a
         new node can bump pair_width mid-stream, and the hot loop must not
         pay that compile either."""
+        if not delta_sizes:
+            return
         pn = self.snapshot.padded_nodes
         dim = self.snapshot.features.shape[1]
         chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
         cur_w = self._batch.pair_width
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
+        out = None
         for pk in delta_sizes:
             idx = np.full(pk, pn, dtype=np.int32)   # all-dropped delta
             rows = np.zeros((pk, dim), np.float32)
@@ -182,6 +187,7 @@ class StreamingScorer:
                     *self._ev_args, *self._pair_args, chain,
                     padded_incidents=self._batch.padded_incidents,
                     pair_width=pw)
+        if out is not None:
             self._features_dev = out[0]   # no-op update; keep handle fresh
 
     def dispatch(self) -> tuple:

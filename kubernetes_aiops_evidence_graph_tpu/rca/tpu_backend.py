@@ -156,7 +156,8 @@ _PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
                 ev_dst: np.ndarray,
-                layout: EvidenceLayout | None = None) -> tuple[np.ndarray, int]:
+                layout: EvidenceLayout | None = None,
+                min_width: int = 0) -> tuple[np.ndarray, int]:
     """Per-evidence-slot pair ids for multiple_pods_same_node.
 
     Joins incident->pod evidence with pod->node SCHEDULED_ON edges and
@@ -166,7 +167,12 @@ def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
     load-bearing): slot (i, w) holds the local pair id of evidence w's
     node, or Wr when that evidence is not a pod-on-a-node. The only part of
     the batch that changes on a pod reschedule, so the streaming path
-    refreshes just this array (reusing its cached layout)."""
+    refreshes just this array (reusing its cached layout).
+
+    ``min_width`` floors the returned width: streaming passes its current
+    compiled pair_width so a shrinking bucket never produces a table whose
+    "no node" sentinel (== the returned width) would land IN range of the
+    wider one_hot the resident program was compiled for."""
     pi = snapshot.padded_incidents
     live = snapshot.edge_mask > 0
     src = snapshot.edge_src[live]
@@ -199,6 +205,7 @@ def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
         local_of_pair = np.zeros(0, np.int64)
         inv = np.zeros(0, np.int64)
         wr = _PAIR_WIDTH_BUCKETS[0]
+    wr = max(wr, min_width)
 
     ev_pair_slot = np.full((pi, lo.width), wr, dtype=np.int32)  # wr = "no node"
     if len(rows_s) and on_node.any():

@@ -145,6 +145,8 @@ CREATE TABLE IF NOT EXISTS hypothesis_feedback (
     submitted_by TEXT NOT NULL DEFAULT 'unknown',
     submitted_at TEXT NOT NULL
 );
+CREATE INDEX IF NOT EXISTS ix_feedback_hypothesis
+    ON hypothesis_feedback(hypothesis_id);
 
 CREATE TABLE IF NOT EXISTS workflow_journal (
     workflow_id TEXT NOT NULL,
@@ -346,19 +348,25 @@ class Database:
                 (str(incident_id),))
         ]
 
-    def insert_feedback(self, fb) -> None:
+    def insert_feedback(self, fb) -> bool:
         """Record operator feedback on a hypothesis (HypothesisFeedback —
         the model the reference defines but never persists,
-        hypothesis.py:169-176)."""
+        hypothesis.py:169-176). Existence check and insert are ONE
+        statement: a separate check-then-act would race the worker thread's
+        re-analysis (insert_hypotheses deletes + re-inserts rows with fresh
+        ids) and leave orphan feedback. Returns False when the hypothesis
+        is unknown."""
         with self._lock:
-            self.conn.execute(
+            cur = self.conn.execute(
                 "INSERT INTO hypothesis_feedback (hypothesis_id, was_correct,"
                 " actual_root_cause, feedback_notes, submitted_by,"
-                " submitted_at) VALUES (?,?,?,?,?,?)",
+                " submitted_at) SELECT ?,?,?,?,?,? WHERE EXISTS"
+                " (SELECT 1 FROM hypotheses WHERE id=?)",
                 (str(fb.hypothesis_id), int(fb.was_correct),
                  fb.actual_root_cause, fb.feedback_notes, fb.submitted_by,
-                 fb.submitted_at.isoformat()))
+                 fb.submitted_at.isoformat(), str(fb.hypothesis_id)))
             self.conn.commit()
+            return cur.rowcount > 0
 
     def feedback_for(self, hypothesis_id: UUID | str) -> list[dict]:
         return [dict(r) for r in self.query(

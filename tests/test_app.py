@@ -139,6 +139,27 @@ def test_graph_persistence_across_restart(tmp_path):
     app2.db.close()
 
 
+def test_corrupt_graph_persist_file_does_not_block_startup(tmp_path):
+    """A corrupt/incompatible persist file must not prevent the server from
+    starting (symmetric with stop(), which never lets persistence failures
+    block shutdown): the bad file is moved aside and the store starts
+    empty (ADVICE r1)."""
+    import os
+    cluster = generate_cluster(num_pods=64, seed=1)
+    gpath = str(tmp_path / "graph.jsonl")
+    with open(gpath, "w") as f:
+        f.write('{"not": "a graph reco')   # truncated garbage
+    settings = load_settings(
+        api_port=0, db_path=":memory:", graph_persist_path=gpath,
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    app = AiopsApp(cluster, settings)
+    assert app.store.node_count() == 0
+    assert not os.path.exists(gpath)
+    assert os.path.exists(gpath + ".corrupt")
+    app.db.close()
+
+
 def test_concurrent_webhooks_all_complete(served):
     """The threaded HTTP server + single worker loop must absorb parallel
     webhook bursts without losing or duplicating incidents."""
@@ -207,3 +228,14 @@ def test_hypothesis_feedback_roundtrip(served):
     except urllib.error.HTTPError as e:
         assert e.code == 400
     assert len(_get(base, f"/api/v1/hypotheses/{hid}/feedback")["feedback"]) == 1
+
+    # well-formed feedback for a hypothesis that doesn't exist -> 404,
+    # no orphan row accumulates
+    ghost = "00000000-0000-0000-0000-00000000beef"
+    try:
+        _post(base, f"/api/v1/hypotheses/{ghost}/feedback",
+              {"was_correct": False, "submitted_by": "sre-bob"})
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    assert _get(base, f"/api/v1/hypotheses/{ghost}/feedback")["feedback"] == []

@@ -132,3 +132,49 @@ def test_steady_state_ticks_never_recompile():
 
     assert _update_and_score._cache_size() == baseline, (
         "steady-state ticks recompiled the fused kernel")
+
+
+def test_warm_empty_delta_sizes_is_noop():
+    """warm(delta_sizes=()) must be a clean no-op (regression: referenced
+    the loop variable after a zero-iteration loop -> NameError)."""
+    _cluster, builder, _ = _world()
+    scorer = StreamingScorer(builder.store, SMALL)
+    scorer.warm(delta_sizes=())
+    out = scorer.rescore()
+    assert out["scores"].shape[0] == len(out["incident_ids"])
+
+
+def test_pair_tables_sentinel_respects_min_width():
+    """If the pair-width bucket shrinks mid-stream, the streaming path keeps
+    the old (larger) compiled width. The 'no node' sentinel must then be
+    stamped with the CLAMPED width — a sentinel equal to the smaller natural
+    width would be in range of the wider one_hot and count phantom pods
+    into multiple_pods_same_node (ADVICE r1, medium)."""
+    import jax.numpy as jnp
+    from kubernetes_aiops_evidence_graph_tpu.rca.tpu_backend import (
+        _PAIR_WIDTH_BUCKETS, evidence_coo, evidence_layout, pair_contract,
+        pair_tables,
+    )
+
+    _cluster, builder, _ = _world()
+    snap = build_snapshot(builder.store, SMALL)
+    ev_rows, ev_dst = evidence_coo(snap)
+    layout = evidence_layout(ev_rows, snap.padded_incidents)
+
+    slot0, w0 = pair_tables(snap, ev_rows, ev_dst, layout=layout)
+    bigger = next(w for w in _PAIR_WIDTH_BUCKETS if w > w0)
+    slot1, w1 = pair_tables(snap, ev_rows, ev_dst, layout=layout,
+                            min_width=bigger)
+    assert w1 == bigger
+    # every no-node slot carries the clamped sentinel, none the natural one
+    assert not np.any(slot1 == w0)
+    assert np.any(slot1 == w1)
+
+    # phantom check: contracting "every evidence slot is a problem" flags
+    # must yield identical per-pair counts under both widths — the clamped
+    # sentinel one-hots to zero exactly like the natural one did
+    problem = jnp.ones(slot0.shape, jnp.float32)
+    c0 = np.asarray(pair_contract(problem, jnp.asarray(slot0), w0))
+    c1 = np.asarray(pair_contract(problem, jnp.asarray(slot1), w1))
+    np.testing.assert_array_equal(c0, c1[:, :w0])
+    assert not c1[:, w0:].any(), "sentinel leaked into a real pair column"
