@@ -307,21 +307,24 @@ class LiveClusterBackend:
 
     # -- Prometheus --------------------------------------------------------
 
+    def _render_query(self, namespace: str, service: str,
+                      query_name: str) -> str | None:
+        from .metrics import load_query_library
+        for queries in load_query_library().values():
+            if query_name in queries:
+                return (queries[query_name]
+                        .replace("{{namespace}}", namespace)
+                        .replace("{{deployment}}", service)
+                        .replace("{{pod_prefix}}", _pod_prefix(service)))
+        return None
+
     def query_metric(self, namespace: str, service: str, query_name: str) -> float | None:
         """Render the named query from the promql library and take the max
         sample of a Prometheus instant query (metrics_collector.py:161-185;
         the fake backend answers the same names from its metric table)."""
-        from .metrics import load_query_library
-        promql = None
-        for queries in load_query_library().values():
-            if query_name in queries:
-                promql = queries[query_name]
-                break
+        promql = self._render_query(namespace, service, query_name)
         if promql is None:
             return None
-        promql = (promql.replace("{{namespace}}", namespace)
-                  .replace("{{deployment}}", service)
-                  .replace("{{pod_prefix}}", _pod_prefix(service)))
         try:
             data = self._get(self.prometheus_url, "/api/v1/query", {"query": promql})
         except Exception as exc:
@@ -337,6 +340,41 @@ class LiveClusterBackend:
                 except (TypeError, ValueError):
                     continue
         return max(values) if values else None
+
+    def query_metric_range(self, namespace: str, service: str,
+                           query_name: str, start_s: float,
+                           end_s: float) -> list[tuple[float, float]]:
+        """Prometheus ``query_range`` over the evidence window with the
+        reference's step formula — step = max(15, range // 100)
+        (metrics_collector.py:161-185). All result series are merged and
+        time-sorted; non-finite samples are dropped (:224-236). The caller
+        (collectors/metrics.py) downsamples and computes the stats block."""
+        promql = self._render_query(namespace, service, query_name)
+        if promql is None or end_s <= start_s:
+            return []
+        step = max(15, int(end_s - start_s) // 100)
+        try:
+            data = self._get(self.prometheus_url, "/api/v1/query_range", {
+                "query": promql, "start": int(start_s), "end": int(end_s),
+                "step": step,
+            })
+        except Exception as exc:
+            self._log.warning("prometheus_query_range_failed", error=str(exc))
+            return []
+        samples: list[tuple[float, float]] = []
+        for r in ((data.get("data") or {}).get("result") or []):
+            for pair in r.get("values") or []:
+                if not pair or len(pair) != 2:
+                    continue
+                try:
+                    ts, v = float(pair[0]), float(pair[1])
+                except (TypeError, ValueError):
+                    continue
+                if v == float("inf") or v == float("-inf") or v != v:
+                    continue
+                samples.append((ts, v))
+        samples.sort()
+        return samples
 
 
     # -- mutations (RemediationExecutor write surface; reference

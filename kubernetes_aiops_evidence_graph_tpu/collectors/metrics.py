@@ -1,12 +1,19 @@
-"""Metrics collector — alert-driven PromQL category selection.
+"""Metrics collector — alert-driven PromQL category selection over
+time-series windows.
 
 Parity with the reference MetricsCollector (metrics_collector.py:31-329):
 loads the promql library, selects categories by alertname keywords
-(:78-99), queries the backend per named query, and applies the per-family
-anomaly thresholds (:247-329) to set signal strength. Emits one
-METRIC_SIGNAL evidence per query with ``query_name`` / ``current_value`` /
-``is_anomalous`` — the exact keys the signal fold reads
-(rules_engine.py:337-350).
+(:78-99), queries the backend per named query over the evidence window
+(``query_range``, step = max(15, range/100), :161-185), downsamples to
+≤``max_metric_points`` and keeps last-50 values + min/max/avg/current
+(:195-245), and applies the per-family anomaly thresholds (:247-329) to
+set signal strength. Unlike the reference — which collects the series but
+thresholds only the final sample — the threshold applies to the family's
+windowed statistic (utils/metricseries.EVAL_STAT), so spikes that receded
+and trends racing toward a limit still flip rules. Emits one METRIC_SIGNAL
+evidence per query with ``query_name`` / ``current_value`` /
+``eval_value`` / ``is_anomalous`` + the stats block — the keys the signal
+folds read (rules_engine.py:337-350).
 """
 from __future__ import annotations
 
@@ -15,6 +22,10 @@ from pathlib import Path
 import yaml
 
 from ..models import CollectorResult, EvidenceSource, EvidenceType, Incident
+from ..utils.metricseries import (
+    EVAL_STAT, downsample, eval_value, series_stats,
+)
+from ..utils.timeutils import to_epoch_s
 from .base import BaseCollector
 
 _QUERIES_PATH = Path(__file__).resolve().parent.parent / "config" / "promql_queries.yaml"
@@ -57,7 +68,13 @@ _STRENGTH: dict[str, float] = {
 }
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1)
 def load_query_library() -> dict[str, dict[str, str]]:
+    # memoized: the live backend renders a query per metric per collect and
+    # must not re-read/re-parse the YAML on the per-query hot path
     with open(_QUERIES_PATH) as fh:
         return yaml.safe_load(fh)
 
@@ -81,6 +98,25 @@ class MetricsCollector(BaseCollector):
         super().__init__(backend, settings)
         self.library = load_query_library()
 
+    def _fetch_series(self, incident: Incident,
+                      query_name: str) -> list[tuple[float, float]]:
+        """Window series from the backend; instant-value fallback when the
+        backend predates query_metric_range (single-sample series — stats
+        then degenerate to the instant semantics)."""
+        start, end = self.window(
+            incident, getattr(self.backend, "now", incident.started_at))
+        start_s, end_s = to_epoch_s(start), to_epoch_s(end)
+        range_fn = getattr(self.backend, "query_metric_range", None)
+        if range_fn is not None:
+            samples = range_fn(incident.namespace, incident.service,
+                               query_name, start_s, end_s)
+            if samples:
+                return downsample(sorted(samples),
+                                  self.settings.max_metric_points)
+        value = self.backend.query_metric(
+            incident.namespace, incident.service, query_name)
+        return [] if value is None else [(end_s, float(value))]
+
     def collect(self, incident: Incident) -> CollectorResult:
         result = CollectorResult(collector_name=self.name)
         if not incident.service:
@@ -92,20 +128,27 @@ class MetricsCollector(BaseCollector):
                 if query_name in seen:
                     continue
                 seen.add(query_name)
-                value = self.backend.query_metric(
-                    incident.namespace, incident.service, query_name)
-                if value is None:
+                samples = self._fetch_series(incident, query_name)
+                if not samples:
                     continue
+                stats = series_stats(samples)
+                ev = eval_value(query_name, stats)
                 threshold = _THRESHOLDS.get(query_name)
-                anomalous = threshold is not None and value > threshold
+                anomalous = (threshold is not None and ev is not None
+                             and ev > threshold)
                 result.evidence.append(self.make_evidence(
                     incident, EvidenceType.METRIC_SIGNAL, incident.service,
                     {
                         "query_name": query_name,
                         "category": category,
-                        "current_value": float(value),
+                        "current_value": float(stats["current_value"]),
+                        "eval_value": None if ev is None else float(ev),
+                        "eval_stat": EVAL_STAT.get(query_name, "current"),
                         "threshold": threshold,
                         "is_anomalous": anomalous,
+                        **{k: stats[k] for k in
+                           ("values", "num_points", "min_value", "max_value",
+                            "avg_value", "trend_per_min")},
                     },
                     signal_strength=_STRENGTH.get(query_name, 0.5) if anomalous else 0.3,
                     is_anomaly=anomalous,

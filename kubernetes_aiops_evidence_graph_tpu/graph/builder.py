@@ -50,13 +50,16 @@ _PREFIX_LABEL = {
 def _metric_flags(data: dict) -> dict:
     """Translate a metric evidence payload into the node-property flags the
     feature extractor reads — the same thresholds the CPU signal fold applies
-    (rules_engine.py:337-350), so both backends see identical booleans."""
+    (rules_engine.py:337-350), so both backends see identical booleans.
+    Thresholds read the series eval value via metric_eval (the family's
+    windowed statistic), exactly like rca/signals._fold_metric."""
+    from ..utils.metricseries import metric_eval
     flags: dict = {}
     query_name = data.get("query_name", "") or ""
-    value = data.get("current_value", 0) or 0
+    value = metric_eval(data)
     if "memory" in query_name and data.get("is_anomalous") and value > 90:
         flags["memory_usage_high"] = True
-    if "hpa" in query_name and "max" in query_name and value == 1:
+    if "hpa" in query_name and "max" in query_name and value >= 1:
         flags["hpa_at_max"] = True
     if "latency" in query_name and value > 1:
         flags["latency_high"] = True

@@ -109,17 +109,21 @@ def _fold_pod(data: dict, s: Signals) -> None:
 
 
 def _fold_metric(data: dict, s: Signals) -> None:
-    """Reference _process_metric_evidence (rules_engine.py:337-350)."""
+    """Reference _process_metric_evidence (rules_engine.py:337-350), with
+    thresholds applied to the series eval value (the family's windowed
+    statistic — utils/metricseries.EVAL_STAT) instead of the last sample,
+    so spikes that receded and trends toward a limit still register."""
+    from ..utils.metricseries import metric_eval
     query_name = data.get("query_name", "") or ""
-    if "memory" in query_name and data.get("is_anomalous"):
-        current = data.get("current_value")
-        if current and current > MEMORY_HIGH_PCT:
-            s.memory_usage_high = True
-    if "hpa" in query_name and "max" in query_name and data.get("current_value") == 1:
+    value = metric_eval(data)
+    if "memory" in query_name and data.get("is_anomalous") \
+            and value > MEMORY_HIGH_PCT:
+        s.memory_usage_high = True
+    if "hpa" in query_name and "max" in query_name and value >= 1:
         s.hpa_at_max = True
-    if "latency" in query_name and (data.get("current_value", 0) or 0) > 1:
+    if "latency" in query_name and value > 1:
         s.latency_high = True
-    if "throttl" in query_name and (data.get("current_value", 0) or 0) > 0.5:
+    if "throttl" in query_name and value > 0.5:
         s.cpu_throttling = True
 
 

@@ -99,6 +99,10 @@ class ServiceMetrics:
     oom_events: float = 0.0
     restarts_rate: float = 0.0
     hpa_at_max: float = 0.0  # 0/1 gauge
+    # optional per-query time series [(epoch_s, value), ...]; when present
+    # query_metric_range serves it verbatim (trend/spike scenarios), else a
+    # flat series is synthesized from the instant value above
+    series: dict[str, list[tuple[float, float]]] = field(default_factory=dict)
 
 
 class FakeCluster:
@@ -196,6 +200,9 @@ class FakeCluster:
         m = self.metrics.get(self._key(namespace, service))
         if m is None:
             return None
+        series = m.series.get(query_name)
+        if series:
+            return series[-1][1]
         table = {
             "memory_usage_pct": m.memory_pct,
             "error_rate": m.error_rate,
@@ -206,6 +213,39 @@ class FakeCluster:
             "hpa_at_max": m.hpa_at_max,
         }
         return table.get(query_name)
+
+    def query_metric_range(self, namespace: str, service: str,
+                           query_name: str, start_s: float,
+                           end_s: float) -> list[tuple[float, float]]:
+        """Prometheus query_range analog (metrics_collector.py:161-185):
+        serves the scenario-set series clipped to the window, else a
+        deterministic flat series synthesized from the instant value — so
+        the hermetic path exercises the same series-stats code as live."""
+        m = self.metrics.get(self._key(namespace, service))
+        if m is None:
+            return []
+        series = m.series.get(query_name)
+        if series:
+            return [(t, v) for t, v in series if start_s <= t <= end_s]
+        value = self.query_metric(namespace, service, query_name)
+        if value is None or end_s <= start_s:
+            return []
+        step = max(15.0, (end_s - start_s) / 100.0)
+        n = max(2, int((end_s - start_s) / step))
+        return [(start_s + i * (end_s - start_s) / (n - 1), float(value))
+                for i in range(n)]
+
+    def set_metric_series(self, namespace: str, service: str,
+                          query_name: str, values: list[float],
+                          window_s: float = 900.0) -> None:
+        """Spread ``values`` evenly over the trailing ``window_s`` seconds
+        ending at cluster ``now`` — scenario/test helper for trend series."""
+        from ..utils.timeutils import to_epoch_s
+        end = to_epoch_s(self.now)
+        n = len(values)
+        ts = [end - window_s + (i + 1) * window_s / n for i in range(n)]
+        self.service_metrics(namespace, service).series[query_name] = (
+            list(zip(ts, values)))
 
     def rollout_history(self, namespace: str, deployment: str) -> list[dict]:
         d = self.deployments.get(self._key(namespace, deployment))

@@ -74,6 +74,18 @@ LOKI = {"data": {"result": [{"values": [
 
 PROM = {"data": {"result": [{"value": ["1753790400", "93.5"]}]}}
 
+# query_range: two series (pods of one deployment) with an Inf and a NaN
+# sample that must be dropped; merged + time-sorted by the backend
+PROM_RANGE = {"data": {"result": [
+    {"metric": {"pod": "checkout-abc12-x1"},
+     "values": [["1753790100", "80"], ["1753790200", "+Inf"],
+                ["1753790400", "90"]]},
+    {"metric": {"pod": "checkout-abc12-x2"},
+     "values": [["1753790150", "82"], ["1753790300", "NaN"],
+                ["1753790350", "88"]]},
+]}}
+RANGE_PARAMS: list[dict] = []
+
 
 WRITES: list[tuple[str, str, dict]] = []
 
@@ -114,7 +126,11 @@ class _Handler(BaseHTTPRequestHandler):
                 {"items": []},
             "/loki/api/v1/query_range": LOKI,
             "/api/v1/query": PROM,
+            "/api/v1/query_range": PROM_RANGE,
         }
+        if path == "/api/v1/query_range":
+            RANGE_PARAMS.append(
+                {k: v[0] for k, v in parse_qs(urlparse(self.path).query).items()})
         payload = table.get(path)
         body = json.dumps(payload if payload is not None else {"items": []}).encode()
         self.send_response(200)
@@ -167,6 +183,25 @@ def test_loki_and_prometheus(backend):
     v = backend.query_metric("payments", "checkout", "memory_usage_pct")
     assert v == pytest.approx(93.5)
     assert backend.query_metric("payments", "checkout", "nonexistent_query") is None
+
+
+def test_prometheus_query_range(backend):
+    """query_range wire protocol: reference step formula, multi-series
+    merge, non-finite sample drop (metrics_collector.py:161-245)."""
+    RANGE_PARAMS.clear()
+    samples = backend.query_metric_range(
+        "payments", "checkout", "memory_usage_pct",
+        1753790000.0, 1753790400.0)
+    # Inf and NaN dropped; two series merged and time-sorted
+    assert [v for _, v in samples] == [80.0, 82.0, 88.0, 90.0]
+    assert [t for t, _ in samples] == sorted(t for t, _ in samples)
+    # step = max(15, 400 // 100) = 15
+    assert RANGE_PARAMS[0]["step"] == "15"
+    assert RANGE_PARAMS[0]["start"] == "1753790000"
+    assert RANGE_PARAMS[0]["end"] == "1753790400"
+    assert "payments" in RANGE_PARAMS[0]["query"]
+    assert backend.query_metric_range(
+        "payments", "checkout", "nonexistent_query", 0.0, 100.0) == []
 
 
 def test_k8s_write_surface(backend):

@@ -1,0 +1,180 @@
+"""Time-series metric evidence: windowed stats (VERDICT r1 item 1).
+
+The reference collects Prometheus query_range series, downsamples to ≤500
+points and keeps last-50/min/max/avg/current (metrics_collector.py:161-245)
+but thresholds only the last sample. Here the per-family EVAL_STAT applies
+the threshold to the windowed statistic, so a TREND (memory rising toward
+its limit) or a SUSTAINED elevation (latency high for most of the window
+but dipping at collect time) flips a rule an instant value misses — on
+BOTH backends identically.
+"""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder, build_snapshot
+from kubernetes_aiops_evidence_graph_tpu.models import Incident, IncidentSource
+from kubernetes_aiops_evidence_graph_tpu.rca import RULES, get_backend
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster
+from kubernetes_aiops_evidence_graph_tpu.utils.metricseries import (
+    downsample, eval_value, series_stats, trend_per_min,
+)
+
+SMALL = load_settings(
+    node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+    incident_bucket_sizes=(8, 32),
+)
+
+
+# -- unit: stats block ----------------------------------------------------
+
+def test_downsample_strides_to_max_points():
+    samples = [(float(i), float(i)) for i in range(1000)]
+    out = downsample(samples, 500)
+    assert len(out) <= 500
+    # newest sample always survives: current_value must be the latest point
+    assert out[-1] == samples[-1]
+    assert downsample(samples, 2000) is samples
+    # cap holds in the floor-stride trap zone (max_points < n < 2*max_points)
+    odd = [(float(i), float(i)) for i in range(750)]
+    out = downsample(odd, 500)
+    assert len(out) <= 500 and out[-1] == odd[-1]
+
+
+def test_series_stats_keeps_last_50_and_aggregates():
+    samples = [(float(i), float(i % 7)) for i in range(120)]
+    st = series_stats(samples)
+    assert len(st["values"]) == 50
+    assert st["num_points"] == 120
+    assert st["current_value"] == samples[-1][1]
+    assert st["min_value"] == 0.0 and st["max_value"] == 6.0
+    assert abs(st["avg_value"] - np.mean([v for _, v in samples])) < 1e-9
+
+
+def test_trend_slope_units_per_minute():
+    # +2 per 60s == +2/min
+    samples = [(i * 60.0, 10.0 + 2.0 * i) for i in range(10)]
+    assert abs(trend_per_min(samples) - 2.0) < 1e-9
+    assert trend_per_min(samples[:1]) == 0.0
+
+
+def test_eval_value_per_family():
+    st = {"current_value": 1.0, "max_value": 5.0, "avg_value": 2.0,
+          "trend_per_min": 0.5}
+    assert eval_value("pod_restarts", st) == 5.0          # max
+    assert eval_value("error_rate", st) == 2.0            # avg
+    # projected = max(window max, current + 0.5*15)
+    assert eval_value("memory_usage_pct", st) == 8.5
+    assert eval_value("unknown_metric", st) == 1.0        # current
+
+
+# -- pipeline: trend flips a rule on both backends ------------------------
+
+def _incident(cluster, ns, dname, alertname):
+    from kubernetes_aiops_evidence_graph_tpu.utils.hashing import alert_fingerprint
+    return Incident(
+        fingerprint=alert_fingerprint("alertmanager", alertname, ns, dname),
+        title=f"{alertname}: {dname}", description="t", severity="medium",
+        source=IncidentSource.ALERTMANAGER, cluster="sim", namespace=ns,
+        service=dname,
+        labels={"alertname": alertname, "namespace": ns, "service": dname},
+        started_at=cluster.now,
+    )
+
+
+def _score_both(cluster, incident):
+    results = collect_all(incident, default_collectors(cluster, SMALL),
+                          parallel=False)
+    evidence = [ev.model_dump(mode="json") for r in results for ev in r.evidence]
+    builder = GraphBuilder()
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+    sync_topology(cluster, builder.store)
+    builder.ingest(incident, results)
+    snapshot = build_snapshot(builder.store, SMALL,
+                              now_s=cluster.now.timestamp())
+    cpu = get_backend("cpu").score_incident(incident.id, evidence)
+    raw = get_backend("tpu").score_snapshot(snapshot)
+    tpu_top = RULES[int(raw["top_rule_index"][0])].id if raw["any_match"][0] else None
+    return cpu, tpu_top
+
+
+def test_rising_memory_flips_oom_high_memory():
+    """Memory at 87% (below the 90 threshold) but rising ~1.1%/min: the
+    15-min projection crosses the limit -> oom_high_memory fires. With a
+    flat series at the same instant value it must NOT fire."""
+    cluster = generate_cluster(num_pods=96, seed=3)
+    ns, dname = sorted(cluster.deployments)[0].split("/", 1)
+    inc = _incident(cluster, ns, dname, "HighMemoryUsage")
+
+    # control: flat 87 -> projection adds nothing -> no rule
+    cluster.service_metrics(ns, dname).memory_pct = 87.0
+    cpu, tpu_top = _score_both(cluster, inc)
+    assert "oom_high_memory" not in cpu.rules_matched
+    assert tpu_top != "oom_high_memory"
+
+    # trend: 70 -> 87 over the window; current still 87 < 90
+    cluster.set_metric_series(ns, dname, "memory_usage_pct",
+                              [70 + i * (17 / 14) for i in range(15)])
+    cpu, tpu_top = _score_both(cluster, inc)
+    assert "oom_high_memory" in cpu.rules_matched
+    assert cpu.top_hypothesis.rule_id == "oom_high_memory"
+    assert tpu_top == "oom_high_memory"
+
+
+def test_sustained_latency_flips_hpa_maxed():
+    """HPA at max + latency that was >2.5s for nearly the whole window but
+    dipped to 0.4s at collect time: the window average (not the instant)
+    is what the rule thresholds."""
+    cluster = generate_cluster(num_pods=96, seed=4)
+    ns, dname = sorted(cluster.deployments)[0].split("/", 1)
+    inc = _incident(cluster, ns, dname, "HPAMaxedOut")
+    m = cluster.service_metrics(ns, dname)
+    m.hpa_at_max = 1.0
+
+    # control: instant latency low, flat series -> no hpa_maxed
+    m.p99_latency_s = 0.4
+    cpu, tpu_top = _score_both(cluster, inc)
+    assert "hpa_maxed" not in cpu.rules_matched
+    assert tpu_top != "hpa_maxed"
+
+    # sustained: ten samples ~3s, final dip to 0.4 -> avg ~2.7 > 1
+    cluster.set_metric_series(ns, dname, "latency_p99_seconds",
+                              [3.0] * 10 + [0.4])
+    cpu, tpu_top = _score_both(cluster, inc)
+    assert "hpa_maxed" in cpu.rules_matched
+    assert cpu.top_hypothesis.rule_id == "hpa_maxed"
+    assert tpu_top == "hpa_maxed"
+
+
+def test_metric_evidence_carries_stats_block():
+    cluster = generate_cluster(num_pods=96, seed=5)
+    ns, dname = sorted(cluster.deployments)[0].split("/", 1)
+    cluster.set_metric_series(ns, dname, "memory_usage_pct",
+                              [80.0 + i for i in range(12)])
+    inc = _incident(cluster, ns, dname, "HighMemoryUsage")
+    results = collect_all(inc, default_collectors(cluster, SMALL),
+                          parallel=False)
+    mem = [ev for r in results for ev in r.evidence
+           if ev.data.get("query_name") == "memory_usage_pct"]
+    assert mem
+    d = mem[0].data
+    assert d["num_points"] == 12
+    assert d["min_value"] == 80.0 and d["max_value"] == 91.0
+    assert d["current_value"] == 91.0
+    assert d["eval_stat"] == "projected"
+    assert d["eval_value"] > 91.0          # rising -> projected above current
+    assert len(d["values"]) == 12 and d["values"][-1][1] == 91.0
+    assert d["is_anomalous"]
+
+
+def test_fake_flat_series_matches_instant_semantics():
+    """With no scenario series set, the synthesized flat series must give
+    exactly the instant-value behavior (regression guard for every
+    existing scenario's expectations)."""
+    cluster = generate_cluster(num_pods=96, seed=6)
+    ns, dname = sorted(cluster.deployments)[0].split("/", 1)
+    cluster.service_metrics(ns, dname).memory_pct = 94.0
+    inc = _incident(cluster, ns, dname, "HighMemoryUsage")
+    cpu, tpu_top = _score_both(cluster, inc)
+    assert cpu.top_hypothesis.rule_id == "oom_high_memory"
+    assert tpu_top == "oom_high_memory"
