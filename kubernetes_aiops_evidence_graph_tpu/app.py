@@ -60,6 +60,15 @@ class AiopsApp:
                     log.error("graph_restore_failed", path=path,
                               moved_to=bad, error=str(exc))
         self.store = self.builder.store
+        self._otlp = None
+        if self.settings.otlp_endpoint:
+            from .observability import TRACER
+            from .observability.otlp import OtlpExporter
+            self._otlp = OtlpExporter(self.settings.otlp_endpoint,
+                                      self.settings.otel_service_name)
+            TRACER.on_end = self._otlp.enqueue
+            log.info("otlp_export_enabled",
+                     endpoint=self.settings.otlp_endpoint)
         self.dedup = AlertDeduplicator(self.settings)
         self.rate_limiter = RateLimiter(self.settings)
         self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
@@ -111,6 +120,10 @@ class AiopsApp:
         except Exception as exc:   # never let persistence block shutdown
             log.error("graph_persist_failed", error=str(exc))
         finally:
+            if self._otlp is not None:
+                from .observability import TRACER
+                TRACER.on_end = None
+                self._otlp.close()  # final best-effort flush
             self.db.close()
 
     def ready(self) -> bool:

@@ -44,6 +44,11 @@ class Settings:
     db_path: str = "kaeg.sqlite"                   # replaces Postgres DSN
     graph_persist_path: str = ""                   # optional snapshot dump dir
 
+    # --- tracing export (reference settings.py:90-91 declares these but
+    # --- never wires them; here spans actually ship) ---
+    otlp_endpoint: str = ""                        # e.g. http://tempo:4318
+    otel_service_name: str = "kaeg-tpu"
+
     # --- evidence collection (settings.py:134-136) ---
     evidence_time_window_minutes: int = 15
     max_log_lines: int = 1000

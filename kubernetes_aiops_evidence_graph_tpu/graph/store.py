@@ -28,7 +28,8 @@ class _Node:
     id: str
     kind: EntityKind
     label: str
-    index: int                      # dense, stable insertion index
+    index: int                      # monotone insertion order (sort key ONLY —
+    #   removals leave holes; dense row numbers are assigned at COO build)
     properties: dict[str, Any] = field(default_factory=dict)
 
 
@@ -54,7 +55,8 @@ class EvidenceGraphStore:
         self._out: dict[str, set[tuple[str, RelationKind]]] = {}
         self._in: dict[str, set[tuple[str, RelationKind]]] = {}
         self._version = 0  # bumps on every mutation; snapshot cache key
-        self._coo_cache: tuple[int, list[str], Any, Any] | None = None
+        self._next_index = 0  # monotone: removal never reassigns indices
+        self._coo_cache: tuple[int, list[str], dict[str, int], Any, Any] | None = None
 
     # -- mutation ---------------------------------------------------------
 
@@ -73,7 +75,7 @@ class EvidenceGraphStore:
                         id=e.id,
                         kind=EntityKind.from_label(e.type),
                         label=e.type,
-                        index=len(self._nodes),
+                        index=self._alloc_index(),
                         properties=dict(e.properties),
                     )
                     self._out.setdefault(e.id, set())
@@ -96,7 +98,7 @@ class EvidenceGraphStore:
                         label = nid.split(":", 1)[0].capitalize() if ":" in nid else "Container"
                         self._nodes[nid] = _Node(
                             id=nid, kind=EntityKind.from_label(label), label=label,
-                            index=len(self._nodes),
+                            index=self._alloc_index(),
                         )
                         self._out.setdefault(nid, set())
                         self._in.setdefault(nid, set())
@@ -112,29 +114,60 @@ class EvidenceGraphStore:
             self._version += 1
         return n
 
+    def _alloc_index(self) -> int:
+        """Monotone insertion index. Never reused after removal — the index
+        is a sort key only, so holes are free and removal stays O(degree)."""
+        i = self._next_index
+        self._next_index += 1
+        return i
+
+    def _remove_one(self, node_id: str) -> bool:
+        """O(degree) unlink. Caller holds the lock and bumps the version."""
+        if node_id not in self._nodes:
+            return False
+        for dst, kind in list(self._out.get(node_id, ())):
+            self._edges.pop((node_id, dst, kind), None)
+            self._in[dst].discard((node_id, kind))
+        for src, kind in list(self._in.get(node_id, ())):
+            self._edges.pop((src, node_id, kind), None)
+            self._out[src].discard((node_id, kind))
+        self._out.pop(node_id, None)
+        self._in.pop(node_id, None)
+        del self._nodes[node_id]
+        return True
+
     def remove_node(self, node_id: str) -> bool:
+        """Remove a node and its edges in O(degree) — indices are never
+        reassigned (the round-1 dense rewrite made each removal O(N):
+        ~30M index writes to clean 500 incidents off a 50k-node store)."""
         with self._lock:
-            if node_id not in self._nodes:
-                return False
-            for dst, kind in list(self._out.get(node_id, ())):
-                self._edges.pop((node_id, dst, kind), None)
-                self._in[dst].discard((node_id, kind))
-            for src, kind in list(self._in.get(node_id, ())):
-                self._edges.pop((src, node_id, kind), None)
-                self._out[src].discard((node_id, kind))
-            self._out.pop(node_id, None)
-            self._in.pop(node_id, None)
-            del self._nodes[node_id]
-            # reassign dense indices
-            for i, node in enumerate(self._nodes.values()):
-                node.index = i
-            self._version += 1
-            return True
+            ok = self._remove_one(node_id)
+            if ok:
+                self._version += 1
+            return ok
+
+    def remove_nodes(self, node_ids: Iterable[str]) -> int:
+        """Batch removal with ONE version bump, so a sweep of unrelated
+        removals invalidates the COO/snapshot caches once, not per node."""
+        n = 0
+        with self._lock:
+            for nid in node_ids:
+                if self._remove_one(nid):
+                    n += 1
+            if n:
+                self._version += 1
+        return n
 
     def cleanup_incident(self, incident_id: str) -> int:
         """Remove an incident node and its relations (reference neo4j.py:281-296)."""
         nid = incident_id if incident_id.startswith("incident:") else f"incident:{incident_id}"
         return 1 if self.remove_node(nid) else 0
+
+    def cleanup_incidents(self, incident_ids: Iterable[str]) -> int:
+        """Batch incident cleanup — one lock acquisition, one version bump."""
+        nids = [i if i.startswith("incident:") else f"incident:{i}"
+                for i in incident_ids]
+        return self.remove_nodes(nids)
 
     # -- queries ----------------------------------------------------------
 
@@ -165,13 +198,15 @@ class EvidenceGraphStore:
                 out += [(s, RelationKind(k).name) for s, k in self._in.get(node_id, ())]
             return out
 
-    def _undirected_coo(self) -> tuple[list[str], Any, Any]:
+    def _undirected_coo(self) -> tuple[list[str], dict[str, int], Any, Any]:
         """Version-cached undirected COO edge index for the native BFS
-        kernel. Caller must hold the lock."""
+        kernel, with the id→dense-row map (node .index has holes after
+        removals, so rows are assigned here). Caller must hold the lock."""
         import numpy as np
 
         if self._coo_cache is not None and self._coo_cache[0] == self._version:
-            return self._coo_cache[1], self._coo_cache[2], self._coo_cache[3]
+            return (self._coo_cache[1], self._coo_cache[2],
+                    self._coo_cache[3], self._coo_cache[4])
         nodes = sorted(self._nodes.values(), key=lambda n: n.index)
         ids = [n.id for n in nodes]
         row = {n.id: i for i, n in enumerate(nodes)}
@@ -182,8 +217,8 @@ class EvidenceGraphStore:
             s, d = row[e.src], row[e.dst]
             src[i], dst[i] = s, d
             src[m + i], dst[m + i] = d, s     # reverse edge: BFS is undirected
-        self._coo_cache = (self._version, ids, src, dst)
-        return ids, src, dst
+        self._coo_cache = (self._version, ids, row, src, dst)
+        return ids, row, src, dst
 
     def get_incident_subgraph(self, incident_id: str, depth: int = 3) -> dict[str, Any]:
         """Depth-limited undirected subgraph around an incident — the
@@ -215,8 +250,8 @@ class EvidenceGraphStore:
         if len(self._nodes) >= self._NATIVE_BFS_MIN_NODES:
             from .. import native as _native
             if _native.available():
-                ids, src, dst = self._undirected_coo()
-                seed = self._nodes[nid].index
+                ids, row, src, dst = self._undirected_coo()
+                seed = row[nid]     # dense COO row, NOT .index (holes)
                 reach = _native.khop_reach_native(src, dst, len(ids), seed, depth)
                 if reach is not None:
                     return {ids[i] for i in reach.nonzero()[0]}

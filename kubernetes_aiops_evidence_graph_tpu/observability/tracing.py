@@ -45,6 +45,9 @@ class Tracer:
         self._lock = threading.Lock()
         self._max = max_spans
         self._tls = threading.local()
+        # optional on-end hook (observability/otlp.OtlpExporter.enqueue);
+        # must never raise into the traced code path
+        self.on_end = None
 
     def _current(self) -> Optional[Span]:
         stack = getattr(self._tls, "stack", None)
@@ -77,6 +80,11 @@ class Tracer:
                 self._spans.append(s)
                 if len(self._spans) > self._max:
                     self._spans = self._spans[-self._max:]
+            if self.on_end is not None:
+                try:
+                    self.on_end(s)
+                except Exception:
+                    pass  # telemetry must never break the traced path
 
     def export(self, trace_id: str | None = None) -> list[dict]:
         with self._lock:
