@@ -250,7 +250,7 @@ def bench_streaming(num_pods: int, num_incidents: int, events: int,
     from kubernetes_aiops_evidence_graph_tpu.rca.streaming import StreamingScorer
     from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject, SCENARIOS
     from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
-        apply_event, churn_events, sync_touched_to_store,
+        churn_events, stream_step,
     )
     from kubernetes_aiops_evidence_graph_tpu.collectors import collect_all, default_collectors
     from kubernetes_aiops_evidence_graph_tpu.config import load_settings
@@ -271,41 +271,57 @@ def bench_streaming(num_pods: int, num_incidents: int, events: int,
 
     scorer = StreamingScorer(builder.store, settings)
     scorer.rescore()  # warm compile (+ one fetch)
-    scorer.warm()     # pre-compile the real tick-delta bucket shapes
+    # pre-compile the real tick shapes: 100-event full-mix ticks dirty up
+    # to ~30 incident rows (row bucket 64), so warm that bucket too
+    scorer.warm(delta_sizes=(64, 256), row_sizes=(4, 16, 64))
 
     # Each tick applies events and enqueues a re-score WITHOUT a synchronous
     # host fetch (scorer.dispatch) — results stay device-resident and are
     # synced once at the end. On co-located hosts a per-tick fetch is
     # microseconds; the dev tunnel charges ~75 ms per fetch, which would
     # measure the tunnel, not the pipeline (see bench_rca).
-    stream = list(churn_events(cluster, events, seed=seed + 1))
+    # FULL event mix: mutate-in-place churn PLUS pod creation/deletion and
+    # incident arrival/closure (VERDICT r1 item 2 — the round-1 number
+    # measured only the easy half). stream_step drives cluster + store +
+    # scorer together so the end-state parity check is honest.
+    stream = list(churn_events(
+        cluster, events, seed=seed + 1,
+        incident_ids=tuple(builder.store.incident_ids())))
+    mix = {}
+    for ev in stream:
+        mix[ev.kind] = mix.get(ev.kind, 0) + 1
     t0 = time.perf_counter()
     tick_times = []
-    out = None
     for tick_start in range(0, len(stream), batch_size):
         for ev in stream[tick_start:tick_start + batch_size]:
-            touched = apply_event(cluster, ev)
-            sync_touched_to_store(cluster, builder.store, touched)
-            if ev.kind == "reschedule" and touched:
-                scorer.reschedule_pod(touched[0], f"node:{ev.payload['node']}")
-            scorer.update_nodes(touched)
+            stream_step(cluster, builder.store, scorer, ev)
         t1 = time.perf_counter()
-        out = scorer.dispatch()
+        scorer.dispatch()
         tick_times.append(time.perf_counter() - t1)
-    final = jax.device_get(out)  # single sync for the whole run
+    inc_res = scorer.rescore()   # single sync for the whole run
     wall = time.perf_counter() - t0
     eps = len(stream) / wall
 
-    # correctness: incremental final state == fresh full rebuild
+    # correctness: incremental final state == fresh full rebuild, compared
+    # by incident id (arrivals/closures change the live set and row order)
     fresh = StreamingScorer(builder.store, settings)
-    ref = jax.device_get(fresh.dispatch())
-    n = scorer.snapshot.num_incidents
-    if not np.array_equal(np.asarray(final[3])[:n], np.asarray(ref[3])[:n]):
-        raise SystemExit("STREAMING MISMATCH: incremental top-1 != full rebuild")
+    ref = fresh.rescore()
+    mine = dict(zip(inc_res["incident_ids"],
+                    np.asarray(inc_res["top_rule_index"])))
+    theirs = dict(zip(ref["incident_ids"], np.asarray(ref["top_rule_index"])))
+    if mine.keys() != theirs.keys() or any(
+            mine[k] != theirs[k] for k in mine):
+        raise SystemExit("STREAMING MISMATCH: incremental != full rebuild")
+    structural = sum(v for k, v in mix.items()
+                     if k in ("pod_create", "pod_delete", "incident_arrival",
+                              "incident_close", "reschedule"))
     log(f"streaming: {len(stream)} events in {wall:.2f}s = {eps:.0f} events/s "
-        f"(ticks of {batch_size}; dispatch p50 "
-        f"{statistics.median(tick_times)*1e3:.2f} ms; final state == full "
-        f"rebuild on {n} incidents)")
+        f"({structural} structural incl. {mix.get('pod_create', 0)} creates/"
+        f"{mix.get('pod_delete', 0)} deletes/"
+        f"{mix.get('incident_arrival', 0)} arrivals; ticks of {batch_size}; "
+        f"dispatch p50 {statistics.median(tick_times)*1e3:.2f} ms; "
+        f"rebuilds={scorer.rebuilds}; final state == full rebuild on "
+        f"{len(mine)} incidents)")
     return eps, statistics.median(tick_times)
 
 

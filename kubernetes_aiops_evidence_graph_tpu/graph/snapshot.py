@@ -166,15 +166,24 @@ def build_snapshot(
     settings: Settings | None = None,
     now_s: float | None = None,
     undirected: bool = True,
+    slack: float = 0.0,
 ) -> GraphSnapshot:
     """Tensorize the store. With ``undirected=True`` every edge is emitted in
     both directions — matching apoc.path.subgraphAll's undirected expansion
-    (neo4j.py:174) so propagation reaches owners and dependents alike."""
+    (neo4j.py:174) so propagation reaches owners and dependents alike.
+
+    ``slack`` reserves growth headroom when picking buckets (the streaming
+    scorer passes 1/3 so node creations and incident arrivals land in free
+    padded rows instead of forcing a rebuild — and a rebuild's recompile
+    storm — mid-stream)."""
     cfg = settings or get_settings()
     nodes, edges = store._raw()
 
+    def _pad(k: int) -> int:
+        return max(int(np.ceil(k * (1.0 + slack))), 1)
+
     n = len(nodes)
-    pn = bucket_for(max(n, 1), cfg.node_bucket_sizes)
+    pn = bucket_for(_pad(max(n, 1)), cfg.node_bucket_sizes)
 
     node_kind = np.zeros(pn, dtype=np.int32)
     features = np.zeros((pn, DIM), dtype=np.float32)
@@ -210,7 +219,7 @@ def build_snapshot(
         edge_mask[:m] = 1.0
 
     ni = len(incident_rows)
-    pi = bucket_for(max(ni, 1), cfg.incident_bucket_sizes)
+    pi = bucket_for(_pad(max(ni, 1)), cfg.incident_bucket_sizes)
     incident_nodes = np.zeros(pi, dtype=np.int32)
     incident_mask = np.zeros(pi, dtype=np.float32)
     if ni:

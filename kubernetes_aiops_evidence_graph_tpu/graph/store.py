@@ -158,6 +158,26 @@ class EvidenceGraphStore:
                 self._version += 1
         return n
 
+    def remove_relation(self, source_id: str, target_id: str,
+                        relation_type: str) -> bool:
+        """Remove one edge (Cypher DELETE-relationship analog). O(1)."""
+        kind = RelationKind.from_label(relation_type)
+        with self._lock:
+            if self._edges.pop((source_id, target_id, kind), None) is None:
+                return False
+            self._out[source_id].discard((target_id, kind))
+            self._in[target_id].discard((source_id, kind))
+            self._version += 1
+            return True
+
+    def relations_from(self, source_id: str,
+                       relation_type: str) -> list[str]:
+        """Target ids of this node's outgoing edges of one type."""
+        kind = RelationKind.from_label(relation_type)
+        with self._lock:
+            return sorted(d for d, k in self._out.get(source_id, ())
+                          if k == kind)
+
     def cleanup_incident(self, incident_id: str) -> int:
         """Remove an incident node and its relations (reference neo4j.py:281-296)."""
         nid = incident_id if incident_id.startswith("incident:") else f"incident:{incident_id}"

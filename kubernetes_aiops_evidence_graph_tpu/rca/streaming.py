@@ -1,12 +1,25 @@
 """Streaming incremental re-scoring (BASELINE configs[4]).
 
-Steady-state path for pod churn at ~1k events/sec: the snapshot's feature
-matrix lives in device HBM; churn deltas are applied as a single padded
-scatter-set per tick (no re-extraction of 50k nodes, no re-upload of the
-13MB feature matrix), and re-scoring reuses the resident edge arrays.
-Structural deltas (pod reschedules = SCHEDULED_ON retargets) mutate the
-snapshot's COO arrays in place through an edge-position index and only
-re-run the vectorized numpy prep join (~ms), never a full snapshot rebuild.
+Steady-state path for full-mix churn at ~1k events/sec: the resident device
+state is the feature matrix PLUS the dense evidence tables
+(ev_idx/ev_cnt/ev_pair_slot). Every mutation kind — feature drift, pod
+reschedule, node/edge creation and deletion, incident arrival and closure —
+reduces to two padded scatter deltas applied inside ONE fused device call
+per tick:
+
+* feature delta: [K, DIM] rows scattered into the feature matrix;
+* row delta: [Kr, W] evidence-table rows (slots, counts, pair ids) for the
+  incident rows whose evidence set changed.
+
+The host keeps the authoritative per-incident evidence lists and per-row
+pair maps (node -> row-local pair id for multiple_pods_same_node), so
+structural churn is O(change) bookkeeping + a bounded scatter — the
+Neo4j-MERGE absorption story (reference neo4j.py:95-166) without ever
+rebuilding or re-uploading the snapshot. Free slots come from the padding
+the buckets already carry: new nodes take free feature rows, new incidents
+take free incident rows, new evidence appends into slot slack. Only bucket
+overflow (feature rows, incident rows, slot width) falls back to a full
+snapshot rebuild — counted in stats so benches can prove it stays rare.
 """
 from __future__ import annotations
 
@@ -20,93 +33,375 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Settings, get_settings
-from ..graph.schema import RelationKind
+from ..graph.schema import EntityKind, RelationKind
 from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_features
 from ..graph.store import EvidenceGraphStore
 from ..utils.padding import bucket_for
-from .tpu_backend import (
-    _PAIR_WIDTH_BUCKETS, DeviceBatch, dense_evidence_table, evidence_coo,
-    evidence_layout, pair_tables,
-)
+from .tpu_backend import _PAIR_WIDTH_BUCKETS, _WIDTH_BUCKETS
 
 _DELTA_BUCKETS = (64, 256, 1024, 4096, 16384)
+_ROW_BUCKETS = (4, 16, 64, 256)
+
+_NO_PAIR = -1          # host-side "evidence has no scheduled node" marker
+
+
+class NeedsRebuild(Exception):
+    """A bucket overflowed; the caller fell back to a full rebuild."""
 
 
 @partial(jax.jit, static_argnames=("padded_incidents", "pair_width"))
-def _update_and_score(features, idx, rows, ev_idx, ev_cnt, ev_pair_slot,
-                      chain, padded_incidents: int, pair_width: int):
-    """One fused device call per tick: apply the padded feature delta, then
-    score — halves per-tick dispatches vs update-then-score (each dispatch
-    costs real latency on a tunneled TPU). The caller replaces its features
-    handle with the returned buffer. No buffer donation: the axon-tunneled
-    backend measurably slows down with donated inputs, and the on-device
-    [Pn, DIM] copy is ~µs."""
+def _tick(features, f_idx, f_rows,
+          ev_idx, ev_cnt, ev_pair, r_idx, r_ev, r_cnt, r_pair,
+          chain, padded_incidents: int, pair_width: int):
+    """One fused device call per tick: scatter the padded feature delta and
+    the padded evidence-row delta into the resident state, then score.
+    Out-of-range indices (the padding of each delta) drop out. The caller
+    replaces its state handles with the returned buffers. No buffer
+    donation: the axon-tunneled backend measurably slows with donated
+    inputs, and the on-device copies are ~µs."""
     from .tpu_backend import _aggregate, finish_scores
 
-    features = features.at[idx].set(rows, mode="drop")
+    features = features.at[f_idx].set(f_rows, mode="drop")
+    ev_idx = ev_idx.at[r_idx].set(r_ev, mode="drop")
+    ev_cnt = ev_cnt.at[r_idx].set(r_cnt, mode="drop")
+    ev_pair = ev_pair.at[r_idx].set(r_pair, mode="drop")
     counts, per_row_max = _aggregate(
-        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
+        features, ev_idx, ev_cnt, ev_pair, padded_incidents, pair_width)
     counts = counts + jnp.minimum(chain, 0.0)[:, None]
-    return (features,) + finish_scores(counts, per_row_max, padded_incidents)
+    return (features, ev_idx, ev_cnt, ev_pair) + finish_scores(
+        counts, per_row_max, padded_incidents)
 
 
 class StreamingScorer:
-    """Device-resident scorer with incremental delta application."""
+    """Device-resident scorer with incremental structural + feature deltas."""
 
     def __init__(self, store: EvidenceGraphStore,
                  settings: Settings | None = None) -> None:
         self.settings = settings or get_settings()
         self.store = store
-        self.snapshot: GraphSnapshot = build_snapshot(store, self.settings)
-        self._id_to_idx = {nid: i for i, nid in enumerate(self.snapshot.node_ids)}
-        nodes, _ = store._raw()
-        self._nodes_by_id = {node.id: node for node in nodes}
-        self._features_dev = jnp.asarray(self.snapshot.features)
-        # evidence COO is invariant under reschedules — computed once, and
-        # cached so structural flushes re-run ONLY the pair join (the dense
-        # evidence table and its device upload stay resident)
-        self._ev_coo = evidence_coo(self.snapshot)
-        pi = self.snapshot.padded_incidents
-        self._layout = evidence_layout(self._ev_coo[0], pi)
-        ev_idx, ev_cnt = dense_evidence_table(*self._ev_coo, pi,
-                                              layout=self._layout)
-        ev_pair_slot, pair_width = pair_tables(self.snapshot, *self._ev_coo,
-                                               layout=self._layout)
-        self._batch = DeviceBatch(
-            num_incidents=self.snapshot.num_incidents, padded_incidents=pi,
-            ev_idx=ev_idx, ev_cnt=ev_cnt, ev_pair_slot=ev_pair_slot,
-            pair_width=pair_width, features=self.snapshot.features)
-        self._ev_args = (jnp.asarray(ev_idx), jnp.asarray(ev_cnt))
-        self._pair_args = self._upload_pairs()
-        # edge-position index for SCHEDULED_ON retargets: pod idx -> positions
-        self._sched_pos: dict[int, list[int]] = {}
-        live = self.snapshot.edge_mask > 0
-        for pos in np.nonzero(
-                (self.snapshot.edge_rel == int(RelationKind.SCHEDULED_ON)) & live)[0]:
-            from ..graph.schema import EntityKind
-            src = int(self.snapshot.edge_src[pos])
-            dst = int(self.snapshot.edge_dst[pos])
-            pod = src if self.snapshot.node_kind[src] == int(EntityKind.POD) else dst
-            self._sched_pos.setdefault(pod, []).append(int(pos))
+        self.rebuilds = 0
+        self._init_from_store()
+
+    # -- (re)initialisation ------------------------------------------------
+
+    def _init_from_store(self) -> None:
+        """Tensorize the store and derive the host-authoritative incremental
+        state. Called at construction and on bucket-overflow rebuilds.
+        Buckets are picked with 1/3 growth slack so structural churn lands
+        in free padded rows instead of forcing mid-stream rebuilds."""
+        snap = build_snapshot(self.store, self.settings, slack=1 / 3)
+        self.snapshot: GraphSnapshot = snap
+        pn, pi = snap.padded_nodes, snap.padded_incidents
+
+        # node rows
+        self._node_ids: list[str | None] = list(snap.node_ids) + [None] * (
+            pn - snap.num_nodes)
+        self._id_to_idx: dict[str, int] = {
+            nid: i for i, nid in enumerate(snap.node_ids)}
+        self._free_node_rows: list[int] = list(
+            range(pn - 1, snap.num_nodes - 1, -1))
+
+        # incident rows
+        self._inc_row_of: dict[str, int] = {
+            iid: r for r, iid in enumerate(snap.incident_ids)}
+        self._row_inc: list[str | None] = list(snap.incident_ids) + [None] * (
+            pi - snap.num_incidents)
+        self._free_inc_rows: list[int] = list(
+            range(pi - 1, snap.num_incidents - 1, -1))
+
+        # pod -> scheduled node (for pair ids of new/retargeted evidence)
+        self._pod_node: dict[int, int] = {}
+        live = snap.edge_mask > 0
+        sched = live & (snap.edge_rel == int(RelationKind.SCHEDULED_ON))
+        for pos in np.nonzero(sched)[0]:
+            s, d = int(snap.edge_src[pos]), int(snap.edge_dst[pos])
+            pod, node = (s, d) if snap.node_kind[s] == int(EntityKind.POD) else (d, s)
+            self._pod_node[pod] = node
+
+        # per-incident evidence lists + pair maps (authoritative host state)
+        is_ev = live & ((snap.edge_rel == int(RelationKind.AFFECTS))
+                        | (snap.edge_rel == int(RelationKind.CORRELATES_WITH)))
+        inc_row = np.full(pn, -1, dtype=np.int64)
+        real = snap.incident_mask > 0
+        inc_row[snap.incident_nodes[real]] = np.arange(int(real.sum()))
+        self._row_nodes: list[list[int]] = [[] for _ in range(pi)]
+        self._row_pairs: list[list[int]] = [[] for _ in range(pi)]
+        self._pair_map: list[dict[int, int]] = [{} for _ in range(pi)]
+        self._ev_rows_of_node: dict[int, set[int]] = {}
+        for pos in np.nonzero(is_ev)[0]:
+            r = int(inc_row[snap.edge_src[pos]])
+            if r < 0:
+                continue  # undirected duplicate (dst is the incident)
+            dst = int(snap.edge_dst[pos])
+            self._append_evidence_host(r, dst)
+
+        # static shapes (width also carries 1/3 slack: appended evidence
+        # must not cross a width bucket right away)
+        max_w = max(max((len(v) for v in self._row_nodes), default=1), 1)
+        self.width = bucket_for(max(int(np.ceil(max_w * 4 / 3)), 1),
+                                _WIDTH_BUCKETS)
+        self.pair_width = bucket_for(
+            max(max((len(m) for m in self._pair_map), default=1), 1),
+            _PAIR_WIDTH_BUCKETS)
+
+        # device state
+        self._features_dev = jnp.asarray(snap.features)
+        ev_idx, ev_cnt, ev_pair = self._materialize_rows(range(pi))
+        self._ev_idx_dev = jnp.asarray(ev_idx)
+        self._ev_cnt_dev = jnp.asarray(ev_cnt)
+        self._pair_dev = jnp.asarray(ev_pair)
+
+        # pending deltas
         self._pending_idx: list[int] = []
         self._pending_rows: list[np.ndarray] = []
-        self._structural_dirty = False
+        self._dirty_rows: set[int] = set()
 
-    def _upload_pairs(self) -> tuple:
-        b = self._batch
-        # no block_until_ready: XLA orders the h2d copies before first use,
-        # and forcing them costs a ~70 ms sync per structural flush on the
-        # dev tunnel
-        return (jnp.asarray(b.ev_pair_slot),)
+    def _append_evidence_host(self, r: int, dst: int) -> None:
+        """Host bookkeeping for one evidence slot (no width checks)."""
+        self._row_nodes[r].append(dst)
+        node = self._pod_node.get(dst)
+        if node is None:
+            self._row_pairs[r].append(_NO_PAIR)
+        else:
+            pm = self._pair_map[r]
+            pid = pm.setdefault(node, len(pm))
+            self._row_pairs[r].append(pid)
+        self._ev_rows_of_node.setdefault(dst, set()).add(r)
 
-    # -- delta ingestion --------------------------------------------------
+    def _materialize_pairs(self, rows: Iterable[int]) -> np.ndarray:
+        """[K, W] pair table only (_NO_PAIR becomes the out-of-range
+        sentinel == pair_width)."""
+        rows = list(rows)
+        ev_pair = np.full((len(rows), self.width), self.pair_width, np.int32)
+        for j, r in enumerate(rows):
+            pairs = np.asarray(self._row_pairs[r], np.int32)
+            if len(pairs):
+                ev_pair[j, :len(pairs)] = np.where(
+                    pairs < 0, self.pair_width, pairs)
+        return ev_pair
+
+    def _materialize_rows(self, rows: Iterable[int]
+                          ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """[K, W] slot tables for the given incident rows from host state."""
+        rows = list(rows)
+        k = len(rows)
+        ev_idx = np.zeros((k, self.width), np.int32)
+        ev_cnt = np.zeros(k, np.int32)
+        for j, r in enumerate(rows):
+            nodes = self._row_nodes[r]
+            ev_cnt[j] = len(nodes)
+            if nodes:
+                ev_idx[j, :len(nodes)] = nodes
+        return ev_idx, ev_cnt, self._materialize_pairs(rows)
+
+    # -- bucket management -------------------------------------------------
+
+    def _grow_width(self) -> None:
+        """Slot-width bucket overflow: next bucket, re-ship ALL rows (new
+        static shape -> new program; pays one compile in the hot loop
+        unless warm(include_next_width=True) pre-compiled it)."""
+        self.width = bucket_for(self.width + 1, _WIDTH_BUCKETS)
+        pi = self.snapshot.padded_incidents
+        ev_idx, ev_cnt, ev_pair = self._materialize_rows(range(pi))
+        self._ev_idx_dev = jnp.asarray(ev_idx)
+        self._ev_cnt_dev = jnp.asarray(ev_cnt)
+        self._pair_dev = jnp.asarray(ev_pair)
+        self._dirty_rows.clear()
+
+    def _grow_pair_width(self) -> None:
+        """Pair bucket overflow: bump the bucket and re-stamp sentinels.
+        Never shrinks mid-stream (ADVICE r1: a shrunk sentinel would land
+        in range of the wider compiled one_hot)."""
+        self.pair_width = bucket_for(self.pair_width + 1, _PAIR_WIDTH_BUCKETS)
+        self._pair_dev = jnp.asarray(
+            self._materialize_pairs(range(self.snapshot.padded_incidents)))
+
+    def _rebuild(self) -> None:
+        self.rebuilds += 1
+        self._init_from_store()
+
+    # -- structural mutation API ------------------------------------------
+    #
+    # Callers mutate the store FIRST (it stays authoritative — rebuilds and
+    # parity checks read it), then mirror the change here. Every method is
+    # O(change); on bucket overflow it falls back to _rebuild().
+
+    def add_entity(self, node_id: str) -> int:
+        """New non-incident node: takes a free padded feature row."""
+        if node_id in self._id_to_idx:
+            return self._id_to_idx[node_id]
+        if not self._free_node_rows:
+            self._rebuild()
+            return self._id_to_idx[node_id]
+        row = self._free_node_rows.pop()
+        node = self.store._nodes.get(node_id)
+        self._node_ids[row] = node_id
+        self._id_to_idx[node_id] = row
+        self.snapshot.node_mask[row] = 1.0
+        if node is not None:
+            self.snapshot.node_kind[row] = int(node.kind)
+            feats = extract_node_features(node)
+        else:
+            feats = np.zeros(self.snapshot.features.shape[1], np.float32)
+        self.snapshot.features[row] = feats
+        self._pending_idx.append(row)
+        self._pending_rows.append(feats)
+        return row
+
+    def remove_entity(self, node_id: str) -> bool:
+        """Remove a node: drop its evidence occurrences everywhere, free
+        its feature row, zero its features (stale gathers must fold 0)."""
+        row = self._id_to_idx.pop(node_id, None)
+        if row is None:
+            return False
+        for r in self._ev_rows_of_node.pop(row, set()):
+            keep = [i for i, n in enumerate(self._row_nodes[r]) if n != row]
+            self._row_nodes[r] = [self._row_nodes[r][i] for i in keep]
+            self._row_pairs[r] = [self._row_pairs[r][i] for i in keep]
+            self._dirty_rows.add(r)
+        self._pod_node.pop(row, None)
+        # if the removed entity was a SCHEDULED_ON target, pods lose their
+        # node: their evidence slots revert to the no-pair sentinel (a full
+        # rebuild would see no edge), and the node's pair key must leave
+        # every row's map so a future row reuse can't inherit its pair id
+        stranded = [p for p, n in self._pod_node.items() if n == row]
+        if stranded:
+            for p in stranded:
+                del self._pod_node[p]
+                for r in self._ev_rows_of_node.get(p, set()):
+                    for i, nd in enumerate(self._row_nodes[r]):
+                        if nd == p:
+                            self._row_pairs[r][i] = _NO_PAIR
+                    self._dirty_rows.add(r)
+            for pm in self._pair_map:
+                pm.pop(row, None)
+        self._node_ids[row] = None
+        self._free_node_rows.append(row)
+        self.snapshot.node_mask[row] = 0.0
+        self.snapshot.features[row] = 0.0
+        zero = np.zeros(self.snapshot.features.shape[1], np.float32)
+        self._pending_idx.append(row)
+        self._pending_rows.append(zero)
+        return True
+
+    def add_incident(self, incident_node_id: str,
+                     evidence_node_ids: Iterable[str] = ()) -> int:
+        """Incident arrival: a free incident row + its evidence slots."""
+        if incident_node_id in self._inc_row_of:
+            r = self._inc_row_of[incident_node_id]
+        else:
+            if not self._free_inc_rows:
+                self._rebuild()
+                return self._inc_row_of[incident_node_id]
+            rb = self.rebuilds
+            nrow = self.add_entity(incident_node_id)
+            if self.rebuilds != rb:
+                # node-row exhaustion rebuilt from the (already upserted)
+                # store, which registered the incident — allocating a second
+                # row here would leak the first one
+                return self._inc_row_of[incident_node_id]
+            r = self._free_inc_rows.pop()
+            self._inc_row_of[incident_node_id] = r
+            self._row_inc[r] = incident_node_id
+            self.snapshot.incident_nodes[r] = nrow
+            self.snapshot.incident_mask[r] = 1.0
+        for eid in evidence_node_ids:
+            self.add_evidence(incident_node_id, eid)
+        return r
+
+    def close_incident(self, incident_node_id: str) -> bool:
+        """Incident closure: clear the row's evidence and free it."""
+        nid = incident_node_id if incident_node_id.startswith("incident:") \
+            else f"incident:{incident_node_id}"
+        r = self._inc_row_of.pop(nid, None)
+        if r is None:
+            return False
+        for dst in set(self._row_nodes[r]):
+            s = self._ev_rows_of_node.get(dst)
+            if s is not None:
+                s.discard(r)
+        self._row_nodes[r] = []
+        self._row_pairs[r] = []
+        self._pair_map[r] = {}
+        self._row_inc[r] = None
+        self._free_inc_rows.append(r)
+        self.snapshot.incident_mask[r] = 0.0
+        self._dirty_rows.add(r)
+        self.remove_entity(nid)
+        return True
+
+    def add_evidence(self, incident_node_id: str, entity_node_id: str) -> bool:
+        """New AFFECTS/CORRELATES_WITH evidence edge."""
+        r = self._inc_row_of.get(incident_node_id)
+        dst = self._id_to_idx.get(entity_node_id)
+        if r is None or dst is None:
+            return False
+        if dst in self._row_nodes[r]:
+            return True  # MERGE semantics: duplicate edge is a no-op
+        if len(self._row_nodes[r]) >= self.width:
+            self._append_evidence_host(r, dst)
+            self._grow_width()          # width first: the pair-growth path
+            if self._pair_overflowed(r):  # re-materializes at current width
+                self._grow_pair_width()
+            return True
+        self._append_evidence_host(r, dst)
+        if self._pair_overflowed(r):
+            self._grow_pair_width()
+        self._dirty_rows.add(r)
+        return True
+
+    def _pair_overflowed(self, r: int) -> bool:
+        return len(self._pair_map[r]) > self.pair_width
+
+    def remove_evidence(self, incident_node_id: str,
+                        entity_node_id: str) -> bool:
+        r = self._inc_row_of.get(incident_node_id)
+        dst = self._id_to_idx.get(entity_node_id)
+        if r is None or dst is None or dst not in self._row_nodes[r]:
+            return False
+        i = self._row_nodes[r].index(dst)
+        del self._row_nodes[r][i]
+        del self._row_pairs[r][i]
+        if dst not in self._row_nodes[r]:
+            s = self._ev_rows_of_node.get(dst)
+            if s is not None:
+                s.discard(r)
+        self._dirty_rows.add(r)
+        return True
+
+    def schedule_pod(self, pod_id: str, node_id: str) -> bool:
+        """New or retargeted SCHEDULED_ON edge: every evidence slot holding
+        this pod gets the pair id of the new node (allocating a row-local id
+        if the node is new to that row)."""
+        pod = self._id_to_idx.get(pod_id)
+        node = self._id_to_idx.get(node_id)
+        if pod is None or node is None:
+            return False
+        self._pod_node[pod] = node
+        grew = False
+        for r in self._ev_rows_of_node.get(pod, set()):
+            pm = self._pair_map[r]
+            pid = pm.setdefault(node, len(pm))
+            for i, n in enumerate(self._row_nodes[r]):
+                if n == pod:
+                    self._row_pairs[r][i] = pid
+            if len(pm) > self.pair_width:
+                grew = True
+            self._dirty_rows.add(r)
+        if grew:
+            self._grow_pair_width()
+        return True
+
+    # back-compat alias (round-1 API)
+    def reschedule_pod(self, pod_id: str, new_node_id: str) -> bool:
+        return self.schedule_pod(pod_id, new_node_id)
 
     def update_nodes(self, node_ids: Iterable[str]) -> int:
         """Queue feature re-extraction for nodes whose properties changed."""
         n = 0
         for nid in node_ids:
             idx = self._id_to_idx.get(nid)
-            node = self._nodes_by_id.get(nid)
+            node = self.store._nodes.get(nid)
             if idx is None or node is None:
                 continue
             row = extract_node_features(node)
@@ -116,23 +411,9 @@ class StreamingScorer:
             n += 1
         return n
 
-    def reschedule_pod(self, pod_id: str, new_node_id: str) -> bool:
-        """Retarget the pod's SCHEDULED_ON edges in the COO arrays."""
-        pod = self._id_to_idx.get(pod_id)
-        new_node = self._id_to_idx.get(new_node_id)
-        if pod is None or new_node is None:
-            return False
-        for pos in self._sched_pos.get(pod, ()):
-            if self.snapshot.edge_src[pos] == pod:      # forward pod->node
-                self.snapshot.edge_dst[pos] = new_node
-            else:                                        # reversed duplicate
-                self.snapshot.edge_src[pos] = new_node
-        self._structural_dirty = True
-        return True
+    # -- scoring -----------------------------------------------------------
 
-    # -- scoring ----------------------------------------------------------
-
-    def _pending_delta(self) -> tuple[np.ndarray, np.ndarray]:
+    def _pending_feature_delta(self) -> tuple[np.ndarray, np.ndarray]:
         """Drain queued feature updates into padded (idx, rows) arrays."""
         k = len(self._pending_idx)
         pk = bucket_for(max(k, 1), _DELTA_BUCKETS)
@@ -146,94 +427,117 @@ class StreamingScorer:
             self._pending_rows.clear()
         return idx, rows
 
-    def _refresh_pairs(self) -> None:
-        # reschedules only retarget SCHEDULED_ON edges: the evidence table
-        # is untouched, so refresh just the pair tables
-        from dataclasses import replace
-        # never SHRINK pair_width mid-stream: a smaller bucket would be a
-        # program warm() hasn't compiled. The floor goes INTO pair_tables so
-        # the "no node" sentinel is stamped with the clamped width — a
-        # sentinel stamped with a smaller, unclamped width would land in
-        # range of the wider compiled one_hot and count phantom pods.
-        ev_pair_slot, pair_width = pair_tables(
-            self.snapshot, *self._ev_coo, layout=self._layout,
-            min_width=self._batch.pair_width)
-        self._batch = replace(
-            self._batch, ev_pair_slot=ev_pair_slot, pair_width=pair_width)
-        self._pair_args = self._upload_pairs()
-        self._structural_dirty = False
+    def _pending_row_delta(self) -> tuple[np.ndarray, ...]:
+        """Drain dirty incident rows into padded scatter arrays."""
+        rows = sorted(self._dirty_rows)
+        self._dirty_rows.clear()
+        k = len(rows)
+        pk = bucket_for(max(k, 1), _ROW_BUCKETS)
+        pi = self.snapshot.padded_incidents
+        r_idx = np.full(pk, pi, dtype=np.int32)    # out-of-range -> dropped
+        r_ev = np.zeros((pk, self.width), np.int32)
+        r_cnt = np.zeros(pk, np.int32)
+        r_pair = np.full((pk, self.width), self.pair_width, np.int32)
+        if k:
+            ev_idx, ev_cnt, ev_pair = self._materialize_rows(rows)
+            r_idx[:k] = rows
+            r_ev[:k], r_cnt[:k], r_pair[:k] = ev_idx, ev_cnt, ev_pair
+        return r_idx, r_ev, r_cnt, r_pair
 
-    def warm(self, delta_sizes: tuple[int, ...] = (64, 256)) -> None:
-        """Pre-compile the fused tick program for the given delta buckets so
-        the first real tick doesn't pay a compile (each distinct padded
-        delta size is a distinct XLA program). Also warms the NEXT
-        pair-width bucket: a reschedule spreading one incident's pods onto a
-        new node can bump pair_width mid-stream, and the hot loop must not
-        pay that compile either."""
+    def warm(self, delta_sizes: tuple[int, ...] = (64, 256),
+             row_sizes: tuple[int, ...] = (4, 16),
+             include_next_width: bool = False) -> None:
+        """Pre-compile the fused tick for the given delta buckets plus the
+        NEXT pair-width bucket (a reschedule can bump it mid-stream), so hot
+        ticks never pay a compile. ``include_next_width=True`` additionally
+        warms the next slot-WIDTH bucket (stand-in zero tables at that
+        width), so an evidence-append overflow doesn't compile in the hot
+        loop either — at roughly double the warm-up compiles."""
         if not delta_sizes:
             return
         pn = self.snapshot.padded_nodes
+        pi = self.snapshot.padded_incidents
         dim = self.snapshot.features.shape[1]
-        chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
-        cur_w = self._batch.pair_width
+        chain = jnp.zeros((pi,), jnp.float32)
+        cur_w = self.pair_width
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
+        widths = [self.width]
+        if include_next_width:
+            widths.append(bucket_for(self.width + 1, _WIDTH_BUCKETS))
         out = None
-        for pk in delta_sizes:
-            idx = np.full(pk, pn, dtype=np.int32)   # all-dropped delta
-            rows = np.zeros((pk, dim), np.float32)
-            for pw in {cur_w, next_w}:
-                out = _update_and_score(
-                    self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
-                    *self._ev_args, *self._pair_args, chain,
-                    padded_incidents=self._batch.padded_incidents,
-                    pair_width=pw)
-        if out is not None:
-            self._features_dev = out[0]   # no-op update; keep handle fresh
+        for width in widths:
+            if width == self.width:
+                tables = (self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev)
+            else:   # stand-ins at the next width; result discarded
+                tables = (jnp.zeros((pi, width), jnp.int32),
+                          self._ev_cnt_dev,
+                          jnp.full((pi, width), cur_w, jnp.int32))
+            for pk in delta_sizes:
+                f_idx = np.full(pk, pn, dtype=np.int32)   # all-dropped deltas
+                f_rows = np.zeros((pk, dim), np.float32)
+                for rk in row_sizes or (_ROW_BUCKETS[0],):
+                    r_idx = np.full(rk, pi, dtype=np.int32)
+                    r_ev = np.zeros((rk, width), np.int32)
+                    r_cnt = np.zeros(rk, np.int32)
+                    for pw in {cur_w, next_w}:
+                        r_pair = np.full((rk, width), pw, np.int32)
+                        res = _tick(
+                            self._features_dev, jnp.asarray(f_idx),
+                            jnp.asarray(f_rows), *tables,
+                            jnp.asarray(r_idx), jnp.asarray(r_ev),
+                            jnp.asarray(r_cnt), jnp.asarray(r_pair), chain,
+                            padded_incidents=pi, pair_width=pw)
+                        if width == self.width:
+                            out = res
+        if out is not None:   # no-op deltas; keep handles fresh
+            (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
+             self._pair_dev) = out[:4]
 
     def dispatch(self) -> tuple:
         """Flush pending deltas and enqueue one scoring pass; returns the
-        device result handles without a host fetch. The steady-state tick
-        path (feature deltas only) is ONE fused device call: apply the
-        padded delta + score. On co-located hosts the fetch is
-        microseconds, but it can be overlapped/batched (the dev tunnel
-        charges ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
-        if self._structural_dirty:
-            self._refresh_pairs()  # rare path; the feature delta rides the
-                                   # fused call below either way
-        chain = jnp.zeros((self._batch.padded_incidents,), jnp.float32)
-        idx, rows = self._pending_delta()
-        out = _update_and_score(
-            self._features_dev, jnp.asarray(idx), jnp.asarray(rows),
-            *self._ev_args, *self._pair_args, chain,
-            padded_incidents=self._batch.padded_incidents,
-            pair_width=self._batch.pair_width,
+        device result handles without a host fetch (the dev tunnel charges
+        ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
+        chain = jnp.zeros((self.snapshot.padded_incidents,), jnp.float32)
+        f_idx, f_rows = self._pending_feature_delta()
+        r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+        out = _tick(
+            self._features_dev, jnp.asarray(f_idx), jnp.asarray(f_rows),
+            self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
+            jnp.asarray(r_idx), jnp.asarray(r_ev), jnp.asarray(r_cnt),
+            jnp.asarray(r_pair), chain,
+            padded_incidents=self.snapshot.padded_incidents,
+            pair_width=self.pair_width,
         )
-        self._features_dev = out[0]
-        return out[1:]
+        (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
+         self._pair_dev) = out[:4]
+        return out[4:]
+
+    def live_incidents(self) -> tuple[list[str], list[int]]:
+        """(incident ids, their rows) for live incidents, in row order —
+        before any arrival/closure this is exactly the snapshot's incident
+        order, so results align with a fresh build_snapshot."""
+        pairs = sorted((r, iid) for iid, r in self._inc_row_of.items())
+        return [p[1] for p in pairs], [p[0] for p in pairs]
 
     def rescore(self) -> dict:
         stats = {"feature_updates": len(self._pending_idx),
-                 "structural_refresh": self._structural_dirty}
-        t0 = time.perf_counter()
-        if self._structural_dirty:
-            self._refresh_pairs()
-        flush_s = time.perf_counter() - t0
+                 "structural_refresh": bool(self._dirty_rows),
+                 "rebuilds": self.rebuilds}
         t1 = time.perf_counter()
         out = self.dispatch()
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
             jax.device_get(out))
         device_s = time.perf_counter() - t1
-        n = self.snapshot.num_incidents
+        ids, rows = self.live_incidents()
         return {
-            "incident_ids": self.snapshot.incident_ids,
-            "conditions": conds[:n],
-            "matched": matched[:n],
-            "scores": scores[:n],
-            "top_rule_index": top_idx[:n],
-            "any_match": any_match[:n],
-            "top_confidence": top_conf[:n],
-            "top_score": top_score[:n],
-            "flush_seconds": flush_s,
+            "incident_ids": tuple(ids),
+            "conditions": conds[rows],
+            "matched": matched[rows],
+            "scores": scores[rows],
+            "top_rule_index": top_idx[rows],
+            "any_match": any_match[rows],
+            "top_confidence": top_conf[rows],
+            "top_score": top_score[rows],
             "device_seconds": device_s,
             **stats,
         }
