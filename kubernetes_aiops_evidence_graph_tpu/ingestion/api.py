@@ -211,6 +211,24 @@ class ApiHandler(BaseHTTPRequestHandler):
         depth = int(self.query.get("depth", 3))  # main.py:303 default depth=3
         self._json(200, self.app.store.get_incident_subgraph(incident_id, depth=depth))
 
+    @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/blast-propagation")
+    def incident_blast_propagation(self, incident_id: str):
+        """Device-computed blast map: k-hop reach bound + label-propagation
+        ranking over the tensorized graph (rca/blast.py)."""
+        from ..rca.blast import blast_propagation
+        out = blast_propagation(
+            self.app.store, incident_id,
+            settings=self.app.settings,
+            hops=int(self.query.get("hops", 3)),
+            iterations=int(self.query.get("iterations", 3)),
+            top_k=int(self.query.get("top_k", 25)),
+        )
+        if out is None:
+            self._json(404, {"error": "incident not in graph",
+                             "incident_id": incident_id})
+            return
+        self._json(200, out)
+
     @route("GET", r"/api/v1/incidents/(?P<incident_id>[0-9a-f-]+)/evidence")
     def incident_evidence(self, incident_id: str):
         self._json(200, {"evidence": self.app.db.evidence_for(incident_id)})
