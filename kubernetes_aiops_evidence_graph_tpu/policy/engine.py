@@ -127,28 +127,33 @@ def evaluate(p: PolicyInput) -> PolicyResult:
             and p.action_type not in HIGH_RISK_ACTIONS
         )
         reasons: list[str] = []
-        if not env_ok and p.action_type in HIGH_RISK_ACTIONS:
-            reasons.append(f"Action {p.action_type} is high risk and not allowed")
-        if not env_ok and p.environment in ("staging", "prod") \
-                and in_freeze_window(p):
-            reasons.append("Action not allowed during freeze window")
+        if not env_ok:
+            # every env-level deny carries its own cause, independent of
+            # whether namespace/blast checks below also fail — the reference
+            # Rego leaves a plain allowlist miss (e.g. cordon_node in prod
+            # outside a freeze) reasonless (remediation.rego:146-166 has no
+            # rule for it); that is a gap we fix rather than replicate
+            env_explained = False
+            if p.action_type in HIGH_RISK_ACTIONS:
+                reasons.append(
+                    f"Action {p.action_type} is high risk and not allowed")
+                env_explained = True
+            if p.environment in ("staging", "prod") and in_freeze_window(p):
+                reasons.append("Action not allowed during freeze window")
+                env_explained = True
+            if not env_explained:
+                if ALLOWED_ACTIONS.get(p.environment) is None:
+                    reasons.append(
+                        f"Environment {p.environment} has no action allowlist")
+                else:
+                    reasons.append(
+                        f"Action {p.action_type} is not in the"
+                        f" {p.environment} allowlist")
         if not namespace_allowed(p):
             reasons.append(f"Namespace {p.namespace} is protected")
         if not blast_radius_ok(p):
             reasons.append(
                 f"Blast radius score {p.blast_radius_score} exceeds threshold")
-        # every deny carries a reason — the reference Rego leaves a plain
-        # allowlist miss (e.g. cordon_node in prod outside a freeze)
-        # reasonless (remediation.rego:146-166 has no rule for it); that is
-        # a gap we fix rather than replicate, like SURVEY §3.6's defects
-        if not env_ok and not reasons:
-            if ALLOWED_ACTIONS.get(p.environment) is None:
-                reasons.append(
-                    f"Environment {p.environment} has no action allowlist")
-            else:
-                reasons.append(
-                    f"Action {p.action_type} is not in the"
-                    f" {p.environment} allowlist")
         return PolicyResult(
             allow=allow,
             requires_approval=requires_approval(p),

@@ -13,6 +13,8 @@ the topological SPREAD.
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -22,18 +24,20 @@ from ..graph.snapshot import GraphSnapshot, build_snapshot
 from ..graph.store import EvidenceGraphStore
 from ..ops.propagate import k_hop_reach, propagate_labels
 
-# snapshot cache keyed by store version: repeated API calls against an
-# unchanged graph skip the O(N) tensorize + device upload
-_CACHE: dict[int, tuple[int, GraphSnapshot]] = {}
+# snapshot cache keyed by (live) store + version: repeated API calls against
+# an unchanged graph skip the O(N) tensorize + device upload. Weak keys mean
+# entries die with their store — no unbounded growth across tests, and no
+# id()-reuse aliasing serving a dead store's snapshot to a new one.
+_CACHE: "weakref.WeakKeyDictionary[EvidenceGraphStore, tuple[int, Settings | None, GraphSnapshot]]" = \
+    weakref.WeakKeyDictionary()
 
 
 def _snapshot(store: EvidenceGraphStore, settings: Settings | None) -> GraphSnapshot:
-    key = id(store)
-    hit = _CACHE.get(key)
-    if hit is not None and hit[0] == store.version:
-        return hit[1]
+    hit = _CACHE.get(store)
+    if hit is not None and hit[0] == store.version and hit[1] is settings:
+        return hit[2]
     snap = build_snapshot(store, settings)
-    _CACHE[key] = (store.version, snap)
+    _CACHE[store] = (store.version, settings, snap)
     return snap
 
 
@@ -66,8 +70,10 @@ def blast_propagation(
         jnp.asarray(snap.edge_mask), num_nodes=pn,
         iterations=iterations, alpha=alpha)
 
-    # rank only nodes inside the k-hop blast set; drop pads and the seed
-    ranked = np.asarray(scores * reach * jnp.asarray(snap.node_mask))
+    # rank only nodes inside the k-hop blast set; drop pads and the seed.
+    # np.array (not asarray): on CPU backends jnp->np is a zero-copy
+    # read-only view, and we mutate ranked[seed] below.
+    ranked = np.array(scores * reach * jnp.asarray(snap.node_mask))
     ranked[seed] = 0.0
     order = np.argsort(-ranked, kind="stable")
     blast = []

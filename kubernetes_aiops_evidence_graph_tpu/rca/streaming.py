@@ -151,9 +151,11 @@ class StreamingScorer:
         self._ev_cnt_dev = jnp.asarray(ev_cnt)
         self._pair_dev = jnp.asarray(ev_pair)
 
-        # pending deltas
-        self._pending_idx: list[int] = []
-        self._pending_rows: list[np.ndarray] = []
+        # pending deltas. The feature delta is a dict keyed by node row so
+        # the LATEST update per row wins: XLA scatter-set order for
+        # duplicate indices is unspecified, so a remove-then-reuse of the
+        # same row within one tick must collapse to one entry (ADVICE r2).
+        self._pending_feat: dict[int, np.ndarray] = {}
         self._dirty_rows: set[int] = set()
 
     def _append_evidence_host(self, r: int, dst: int) -> None:
@@ -164,9 +166,37 @@ class StreamingScorer:
             self._row_pairs[r].append(_NO_PAIR)
         else:
             pm = self._pair_map[r]
-            pid = pm.setdefault(node, len(pm))
+            pid = pm.get(node)
+            if pid is None:
+                # max+1, NOT len(pm): removals can leave holes, and len(pm)
+                # could collide with a live pid (ADVICE r2 high). The dense
+                # invariant (_recompact_pairs) makes these equal, but the
+                # allocator must stay safe even mid-transition.
+                pid = max(pm.values(), default=-1) + 1
+                pm[node] = pid
             self._row_pairs[r].append(pid)
         self._ev_rows_of_node.setdefault(dst, set()).add(r)
+
+    def _recompact_pairs(self, r: int) -> None:
+        """Rebuild row r's pair map dense (0..K-1) from its live slots.
+
+        Called whenever a pair key can go stale — evidence removal, entity
+        removal, pod retarget — so pair ids never develop holes: every pm
+        key is referenced by at least one slot and max pid == len(pm)-1.
+        Without this, a popped key lets ``len(pm)`` alias a live pid and
+        lets the max pid reach ``pair_width`` (the no-node sentinel),
+        silently dropping a real pod from the same-node condition."""
+        pm: dict[int, int] = {}
+        nodes = self._row_nodes[r]
+        pairs = self._row_pairs[r]
+        for i, dst in enumerate(nodes):
+            node = self._pod_node.get(dst)
+            if node is None:
+                pairs[i] = _NO_PAIR
+            else:
+                pairs[i] = pm.setdefault(node, len(pm))
+        self._pair_map[r] = pm
+        self._dirty_rows.add(r)
 
     def _materialize_pairs(self, rows: Iterable[int]) -> np.ndarray:
         """[K, W] pair table only (_NO_PAIR becomes the out-of-range
@@ -244,8 +274,7 @@ class StreamingScorer:
         else:
             feats = np.zeros(self.snapshot.features.shape[1], np.float32)
         self.snapshot.features[row] = feats
-        self._pending_idx.append(row)
-        self._pending_rows.append(feats)
+        self._pending_feat[row] = feats
         return row
 
     def remove_entity(self, node_id: str) -> bool:
@@ -258,30 +287,27 @@ class StreamingScorer:
             keep = [i for i, n in enumerate(self._row_nodes[r]) if n != row]
             self._row_nodes[r] = [self._row_nodes[r][i] for i in keep]
             self._row_pairs[r] = [self._row_pairs[r][i] for i in keep]
-            self._dirty_rows.add(r)
+            self._recompact_pairs(r)  # the slot's pair key may now be stale
         self._pod_node.pop(row, None)
         # if the removed entity was a SCHEDULED_ON target, pods lose their
         # node: their evidence slots revert to the no-pair sentinel (a full
-        # rebuild would see no edge), and the node's pair key must leave
-        # every row's map so a future row reuse can't inherit its pair id
+        # rebuild would see no edge). Recompacting each affected row both
+        # re-stamps those slots and evicts the dead node's pair key, so a
+        # future allocation can never collide with it (ADVICE r2 high).
         stranded = [p for p, n in self._pod_node.items() if n == row]
         if stranded:
+            affected: set[int] = set()
             for p in stranded:
                 del self._pod_node[p]
-                for r in self._ev_rows_of_node.get(p, set()):
-                    for i, nd in enumerate(self._row_nodes[r]):
-                        if nd == p:
-                            self._row_pairs[r][i] = _NO_PAIR
-                    self._dirty_rows.add(r)
-            for pm in self._pair_map:
-                pm.pop(row, None)
+                affected |= self._ev_rows_of_node.get(p, set())
+            for r in affected:
+                self._recompact_pairs(r)
         self._node_ids[row] = None
         self._free_node_rows.append(row)
         self.snapshot.node_mask[row] = 0.0
         self.snapshot.features[row] = 0.0
-        zero = np.zeros(self.snapshot.features.shape[1], np.float32)
-        self._pending_idx.append(row)
-        self._pending_rows.append(zero)
+        self._pending_feat[row] = np.zeros(
+            self.snapshot.features.shape[1], np.float32)
         return True
 
     def add_incident(self, incident_node_id: str,
@@ -351,7 +377,10 @@ class StreamingScorer:
         return True
 
     def _pair_overflowed(self, r: int) -> bool:
-        return len(self._pair_map[r]) > self.pair_width
+        # check the MAX pid, not the map size: with holes (possible only
+        # transiently mid-mutation) the max can reach pair_width — the
+        # "no node" sentinel — while len(pm) still passes (ADVICE r2 high)
+        return max(self._pair_map[r].values(), default=-1) + 1 > self.pair_width
 
     def remove_evidence(self, incident_node_id: str,
                         entity_node_id: str) -> bool:
@@ -366,7 +395,7 @@ class StreamingScorer:
             s = self._ev_rows_of_node.get(dst)
             if s is not None:
                 s.discard(r)
-        self._dirty_rows.add(r)
+        self._recompact_pairs(r)  # prune the pair key if it lost its last ref
         return True
 
     def schedule_pod(self, pod_id: str, node_id: str) -> bool:
@@ -380,14 +409,12 @@ class StreamingScorer:
         self._pod_node[pod] = node
         grew = False
         for r in self._ev_rows_of_node.get(pod, set()):
-            pm = self._pair_map[r]
-            pid = pm.setdefault(node, len(pm))
-            for i, n in enumerate(self._row_nodes[r]):
-                if n == pod:
-                    self._row_pairs[r][i] = pid
-            if len(pm) > self.pair_width:
+            # recompact rather than setdefault(len(pm)): the pod's OLD node
+            # may have just lost its last reference in this row, and a
+            # len-based id could collide with a live pid (ADVICE r2 high)
+            self._recompact_pairs(r)
+            if self._pair_overflowed(r):
                 grew = True
-            self._dirty_rows.add(r)
         if grew:
             self._grow_pair_width()
         return True
@@ -406,25 +433,25 @@ class StreamingScorer:
                 continue
             row = extract_node_features(node)
             self.snapshot.features[idx] = row  # keep host copy coherent
-            self._pending_idx.append(idx)
-            self._pending_rows.append(row)
+            self._pending_feat[idx] = row
             n += 1
         return n
 
     # -- scoring -----------------------------------------------------------
 
     def _pending_feature_delta(self) -> tuple[np.ndarray, np.ndarray]:
-        """Drain queued feature updates into padded (idx, rows) arrays."""
-        k = len(self._pending_idx)
+        """Drain queued feature updates into padded (idx, rows) arrays.
+        The dict source guarantees unique indices — no duplicate-index
+        scatter whose application order XLA leaves unspecified."""
+        k = len(self._pending_feat)
         pk = bucket_for(max(k, 1), _DELTA_BUCKETS)
         pn = self.snapshot.padded_nodes
         idx = np.full(pk, pn, dtype=np.int32)      # out-of-range -> dropped
         rows = np.zeros((pk, self.snapshot.features.shape[1]), np.float32)
         if k:
-            idx[:k] = self._pending_idx
-            rows[:k] = np.stack(self._pending_rows)
-            self._pending_idx.clear()
-            self._pending_rows.clear()
+            idx[:k] = list(self._pending_feat.keys())
+            rows[:k] = np.stack(list(self._pending_feat.values()))
+            self._pending_feat.clear()
         return idx, rows
 
     def _pending_row_delta(self) -> tuple[np.ndarray, ...]:
@@ -520,7 +547,7 @@ class StreamingScorer:
         return [p[1] for p in pairs], [p[0] for p in pairs]
 
     def rescore(self) -> dict:
-        stats = {"feature_updates": len(self._pending_idx),
+        stats = {"feature_updates": len(self._pending_feat),
                  "structural_refresh": bool(self._dirty_rows),
                  "rebuilds": self.rebuilds}
         t1 = time.perf_counter()

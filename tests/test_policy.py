@@ -130,3 +130,20 @@ def test_plain_allowlist_miss_reason_text():
                     current_hour=23))
     assert not r.allow
     assert "high risk" in r.reason and "freeze" not in r.reason
+
+
+def test_allowlist_miss_reason_survives_other_failures():
+    # ADVICE r2: allowlist-miss cause must appear even when other checks
+    # (protected namespace, blast radius) also fail — previously the
+    # fallback was gated on the *global* reasons list being empty.
+    r = evaluate(_p(action_type="cordon_node", environment="prod",
+                    namespace="kube-system", blast_radius_score=90.0,
+                    current_hour=12, is_weekend=False))
+    assert not r.allow
+    joined = r.reason
+    assert "not in the prod allowlist" in joined
+    assert "protected" in joined
+    assert "Blast radius" in joined
+    # uat (no allowlist) + blast failure: both causes reported
+    r = evaluate(_p(environment="uat", blast_radius_score=90.0))
+    assert "no action allowlist" in r.reason and "Blast radius" in r.reason

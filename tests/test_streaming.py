@@ -373,3 +373,89 @@ def test_remove_scheduled_on_target_clears_pair_state():
     np.testing.assert_array_equal(out["matched"][i], ref["matched"][j])
     assert not out["matched"][i, nf], (
         "pods on a deleted node still count as co-located")
+
+
+def test_remove_node_then_schedule_pod_pair_parity():
+    """ADVICE r2 (high) repro: removing a SCHEDULED_ON target pops its pair
+    key out of row maps; a later schedule_pod onto a NEW node must not be
+    handed a colliding pair id (len(pm) aliasing a live pid) — conditions
+    must keep matching a from-scratch rebuild."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import ids as gids
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphEntity, GraphRelation
+
+    cluster, builder, incidents = _world(scenarios=("node_pressure",))
+    store = builder.store
+    scorer = StreamingScorer(store, SMALL)
+    scorer.rescore()
+
+    inc = incidents[0]
+    pods = cluster.list_pods(inc.namespace, inc.service)
+    node_nid = gids.node_id(pods[0].node)
+    store.remove_node(node_nid)
+    scorer.remove_entity(node_nid)
+
+    # new node; strand-recovered pod lands on it
+    new_node = "node:fresh-node-1"
+    store.upsert_entities([GraphEntity(id=new_node, type="Node")])
+    scorer.add_entity(new_node)
+    pod_nid = gids.pod_id(inc.namespace, pods[0].name)
+    store.upsert_relations([GraphRelation(
+        source_id=pod_nid, target_id=new_node,
+        relation_type="SCHEDULED_ON")])
+    scorer.schedule_pod(pod_nid, new_node)
+
+    out = scorer.rescore()
+    ref = StreamingScorer(store, SMALL).rescore()
+    for iid in out["incident_ids"]:
+        i = out["incident_ids"].index(iid)
+        j = ref["incident_ids"].index(iid)
+        np.testing.assert_array_equal(out["matched"][i], ref["matched"][j])
+        np.testing.assert_allclose(out["conditions"][i], ref["conditions"][j],
+                                   rtol=1e-6)
+    # dense pair maps: no holes, no pid at/above the sentinel
+    for pm in scorer._pair_map:
+        if pm:
+            assert sorted(pm.values()) == list(range(len(pm)))
+            assert max(pm.values()) < scorer.pair_width
+
+
+def test_row_reuse_same_tick_keeps_new_features():
+    """ADVICE r2 (medium) repro: pod_delete frees a feature row and a
+    pod_create in the SAME tick reuses it. The zeroing update and the new
+    row used to land as duplicate scatter indices with unspecified order;
+    the new pod's features must win."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import ids as gids
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphEntity, GraphRelation
+    from kubernetes_aiops_evidence_graph_tpu.rca import RULE_INDEX
+
+    cluster, builder, incidents = _world(scenarios=("network",))
+    store = builder.store
+    scorer = StreamingScorer(store, SMALL)
+    scorer.rescore()
+    inc_nid = f"incident:{incidents[0].id}"
+
+    # victim: any pod the store knows that isn't incident evidence
+    victim = next(nid for nid in scorer._id_to_idx
+                  if nid.startswith("pod:"))
+    victim_row = scorer._id_to_idx[victim]
+    store.remove_node(victim)
+    scorer.remove_entity(victim)
+
+    # same-tick create: crashlooping pod reusing the freed row
+    new_pid = gids.pod_id(incidents[0].namespace, "reborn-pod-1")
+    store.upsert_entities([GraphEntity(id=new_pid, type="Pod")])
+    store._nodes[new_pid].properties.update(
+        waiting_reason="CrashLoopBackOff", restart_count=9)
+    row = scorer.add_entity(new_pid)
+    assert row == victim_row, "freed row was not reused (test premise)"
+    store.upsert_relations([GraphRelation(
+        source_id=inc_nid, target_id=new_pid, relation_type="AFFECTS")])
+    scorer.add_evidence(inc_nid, new_pid)
+
+    out = scorer.rescore()   # one tick applies delete + create together
+    i = out["incident_ids"].index(inc_nid)
+    assert out["matched"][i, RULE_INDEX["crashloop_no_change"]], (
+        "new pod's features were zeroed by the stale delete update")
+    ref = StreamingScorer(store, SMALL).rescore()
+    j = ref["incident_ids"].index(inc_nid)
+    np.testing.assert_array_equal(out["matched"][i], ref["matched"][j])
