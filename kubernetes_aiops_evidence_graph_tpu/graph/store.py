@@ -13,7 +13,9 @@ are true batches, and the whole graph tensorizes into a
 """
 from __future__ import annotations
 
+import itertools
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Iterable, Optional
@@ -57,6 +59,37 @@ class EvidenceGraphStore:
         self._version = 0  # bumps on every mutation; snapshot cache key
         self._next_index = 0  # monotone: removal never reassigns indices
         self._coo_cache: tuple[int, list[str], dict[str, int], Any, Any] | None = None
+        # change journal: every structural mutation appends one record so a
+        # resident StreamingScorer can mirror the graph without rebuilding
+        # (the serving-path seam; see rca/streaming.py sync()). Bounded: a
+        # consumer that falls further behind than the buffer must rebuild.
+        self._journal: deque[tuple] = deque(maxlen=200_000)
+        self._seq = 0
+
+    def _jrec(self, *rec: Any) -> None:
+        """Append one journal record. Caller must hold the lock."""
+        self._seq += 1
+        self._journal.append((self._seq, *rec))
+
+    @property
+    def journal_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def journal_since(self, seq: int) -> tuple[list[tuple], int, bool]:
+        """(records with seq > `seq`, current seq, truncated). `truncated`
+        means records after `seq` were evicted from the bounded buffer —
+        the consumer must fall back to a full rebuild."""
+        with self._lock:
+            if not self._journal:
+                return [], self._seq, seq < self._seq
+            oldest = self._journal[0][0]
+            if seq + 1 < oldest:
+                return [], self._seq, True
+            # seqs are contiguous: slice by offset instead of scanning
+            start = seq + 1 - oldest
+            return list(itertools.islice(self._journal, start, None)), \
+                self._seq, False
 
     # -- mutation ---------------------------------------------------------
 
@@ -71,17 +104,20 @@ class EvidenceGraphStore:
             for e in entities:
                 node = self._nodes.get(e.id)
                 if node is None:
+                    kind = EntityKind.from_label(e.type)
                     self._nodes[e.id] = _Node(
                         id=e.id,
-                        kind=EntityKind.from_label(e.type),
+                        kind=kind,
                         label=e.type,
                         index=self._alloc_index(),
                         properties=dict(e.properties),
                     )
                     self._out.setdefault(e.id, set())
                     self._in.setdefault(e.id, set())
+                    self._jrec("node+", e.id, int(kind))
                 else:
                     node.properties.update(e.properties)
+                    self._jrec("node~", e.id)
                 n += 1
             self._version += 1
         return n
@@ -96,18 +132,21 @@ class EvidenceGraphStore:
                 for nid in (r.source_id, r.target_id):
                     if nid not in self._nodes:
                         label = nid.split(":", 1)[0].capitalize() if ":" in nid else "Container"
+                        nkind = EntityKind.from_label(label)
                         self._nodes[nid] = _Node(
-                            id=nid, kind=EntityKind.from_label(label), label=label,
+                            id=nid, kind=nkind, label=label,
                             index=self._alloc_index(),
                         )
                         self._out.setdefault(nid, set())
                         self._in.setdefault(nid, set())
+                        self._jrec("node+", nid, int(nkind))
                 key = (r.source_id, r.target_id, kind)
                 edge = self._edges.get(key)
                 if edge is None:
                     self._edges[key] = _Edge(r.source_id, r.target_id, kind, dict(r.properties))
                     self._out[r.source_id].add((r.target_id, kind))
                     self._in[r.target_id].add((r.source_id, kind))
+                    self._jrec("edge+", r.source_id, r.target_id, int(kind))
                 else:
                     edge.properties.update(r.properties)
                 n += 1
@@ -123,8 +162,10 @@ class EvidenceGraphStore:
 
     def _remove_one(self, node_id: str) -> bool:
         """O(degree) unlink. Caller holds the lock and bumps the version."""
-        if node_id not in self._nodes:
+        node = self._nodes.get(node_id)
+        if node is None:
             return False
+        self._jrec("node-", node_id, int(node.kind))
         for dst, kind in list(self._out.get(node_id, ())):
             self._edges.pop((node_id, dst, kind), None)
             self._in[dst].discard((node_id, kind))
@@ -167,6 +208,7 @@ class EvidenceGraphStore:
                 return False
             self._out[source_id].discard((target_id, kind))
             self._in[target_id].discard((source_id, kind))
+            self._jrec("edge-", source_id, target_id, int(kind))
             self._version += 1
             return True
 

@@ -472,9 +472,17 @@ class TpuRcaBackend:
             "device_seconds": device_s,
         }
 
-    def results(self, snapshot: GraphSnapshot, raw: dict | None = None) -> list[RCAResult]:
-        """Materialize RCAResult models (host-side, for the workflow path)."""
-        raw = raw or self.score_snapshot(snapshot)
+    def results(self, snapshot: GraphSnapshot | None = None,
+                raw: dict | None = None) -> list[RCAResult]:
+        """Materialize RCAResult models (host-side, for the workflow path).
+
+        Accepts either a snapshot to score, or a pre-computed ``raw`` dict —
+        e.g. a StreamingScorer.rescore() result, whose keys are identical —
+        in which case no snapshot is needed at all (the serving path)."""
+        if raw is None:
+            if snapshot is None:
+                raise ValueError("results() needs a snapshot or a raw dict")
+            raw = self.score_snapshot(snapshot)
         out: list[RCAResult] = []
         for i, inc_id in enumerate(raw["incident_ids"]):
             uid = _incident_uuid(inc_id)

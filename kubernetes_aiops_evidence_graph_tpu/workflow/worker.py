@@ -9,6 +9,7 @@ backends (journal idempotency makes replays safe).
 from __future__ import annotations
 
 import asyncio
+import threading
 from typing import Any
 
 from ..config import Settings, get_settings
@@ -43,6 +44,21 @@ class IncidentWorker:
         self._tasks: list[asyncio.Task] = []
         self.completed: int = 0
         self.failed: int = 0
+        # resident serving scorer (tpu backend): created once, mirrors the
+        # store via its change journal — no per-incident snapshot rebuild
+        self.scorer: Any = None
+        self._scorer_lock = threading.Lock()
+
+    def serving_scorer(self) -> Any:
+        """Lazily build the shared StreamingScorer (tpu backend only)."""
+        if self.settings.rca_backend != "tpu":
+            return None
+        with self._scorer_lock:
+            if self.scorer is None:
+                from ..rca.streaming import StreamingScorer
+                self.scorer = StreamingScorer(self.builder.store,
+                                              self.settings)
+            return self.scorer
 
     async def submit(self, incident: Incident) -> None:
         await self.queue.put(incident)
@@ -54,10 +70,15 @@ class IncidentWorker:
                 self.queue.task_done()
                 return
             try:
+                # scorer construction tensorizes the whole store (O(N) +
+                # device upload) — run it on an executor thread so the
+                # one-time cold start never freezes the event loop
+                scorer = await asyncio.get_event_loop().run_in_executor(
+                    None, self.serving_scorer)
                 await run_incident_workflow(
                     incident, self.cluster, self.db, builder=self.builder,
                     settings=self.settings, engine=self.engine,
-                    dedup=self.dedup)
+                    dedup=self.dedup, scorer=scorer)
                 self.completed += 1
             except Exception as exc:
                 self.failed += 1
