@@ -207,9 +207,18 @@ class Database:
         if self._memory_uri:
             conn = sqlite3.connect(self._memory_uri, uri=True, check_same_thread=False)
         else:
-            conn = sqlite3.connect(self.path, check_same_thread=False)
+            conn = sqlite3.connect(self.path, check_same_thread=False,
+                                   timeout=30.0)
         conn.row_factory = sqlite3.Row
         conn.execute("PRAGMA foreign_keys=ON")
+        if not self._memory_uri:
+            # multi-process mode (the Temporal-worker scale-out analog,
+            # reference worker.py:31-73): WAL lets concurrent worker
+            # processes interleave reads with one writer; writer collisions
+            # block-retry for the connect(timeout=30) busy window instead
+            # of raising "database is locked" (tests/test_multiprocess.py)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
         return conn
 
     @property

@@ -4,7 +4,12 @@ The reference worker registers the workflow + activities on task queue
 "incident-workflow" and scales horizontally (worker.py:31-73). Here: an
 asyncio queue with N concurrent workflow slots in one process; horizontal
 scale-out is running more processes against the same SQLite/cluster
-backends (journal idempotency makes replays safe).
+backends. That is a tested claim, not an aspiration: the step journal is
+WAL-mode with busy-timeout writes (storage/sqlite.py _connect) and every
+journal write is an idempotent upsert, so tests/test_multiprocess.py
+proves two real OS processes can contend on one journal and that a
+SIGKILL mid-workflow replays to completion in a second process without
+re-executing completed steps.
 """
 from __future__ import annotations
 

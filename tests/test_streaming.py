@@ -555,3 +555,65 @@ def test_serve_reflects_prior_store_writes():
     from kubernetes_aiops_evidence_graph_tpu.rca import RULES
     i = after["incident_ids"].index(nid)
     assert RULES[int(after["top_rule_index"][i])].id == "oom_killed"
+
+
+def test_sync_unhandled_kinds_cannot_affect_scoring():
+    """VERDICT r3 item 9: sync() mirrors only SCHEDULED_ON / AFFECTS /
+    CORRELATES_WITH edges (plus node ops); every other relation kind —
+    OWNS, SELECTS, CALLS, HAS_RECENT_CHANGE — and incident property
+    updates are intentionally dropped because scoring features are
+    node-local and evidence-edge-driven. This test pins that invariant:
+    journal records of unhandled kinds must leave rescore() bit-identical
+    to a fresh from-store rebuild. If a future feature makes scoring read
+    such topology, this fails and sync() must learn the new kind."""
+    from kubernetes_aiops_evidence_graph_tpu.models import (
+        GraphEntity, GraphRelation)
+
+    cluster, builder, incidents = _world()
+    store = builder.store
+    scorer = StreamingScorer(store, SMALL)
+    scorer.rescore()
+
+    pods = [nid for nid in list(scorer._id_to_idx) if nid.startswith("pod:")]
+    deps = [nid for nid in list(scorer._id_to_idx)
+            if nid.startswith("deployment:")]
+    svcs = [nid for nid in list(scorer._id_to_idx) if nid.startswith("service:")]
+    inc_nid = f"incident:{incidents[0].id}"
+    assert pods and deps and svcs
+
+    # every unhandled edge kind, both directions where meaningful
+    store.upsert_relations([
+        GraphRelation(source_id=deps[0], target_id=pods[0],
+                      relation_type="OWNS"),
+        GraphRelation(source_id=svcs[0], target_id=pods[0],
+                      relation_type="SELECTS"),
+        GraphRelation(source_id=svcs[0], target_id=svcs[-1],
+                      relation_type="CALLS"),
+        GraphRelation(source_id=deps[0],
+                      target_id=f"change:{deps[0]}",
+                      relation_type="HAS_RECENT_CHANGE"),
+    ])
+    # removal records of unhandled kinds too
+    store.remove_relation(svcs[0], svcs[-1], "CALLS")
+    # incident property update (node~ on an incident node): scoring reads
+    # incident features only via its evidence rows, never its own row
+    store.upsert_entities([GraphEntity(
+        id=inc_nid, type="Incident",
+        properties={"note": "prop-update-must-not-affect-scores"})])
+
+    recs, _, _ = store.journal_since(scorer._synced_seq)
+    kinds = {r[1] for r in recs}
+    assert {"edge+", "edge-", "node~"} <= kinds, kinds
+
+    out = scorer.serve()   # drains exactly those records
+
+    fresh = StreamingScorer(store, SMALL)
+    ref = fresh.rescore()
+    assert out["incident_ids"] == ref["incident_ids"]
+    for key in ("conditions", "matched", "scores", "top_rule_index",
+                "any_match", "top_confidence", "top_score"):
+        np.testing.assert_array_equal(
+            np.asarray(out[key])[: len(out["incident_ids"])],
+            np.asarray(ref[key])[: len(ref["incident_ids"])],
+            err_msg=f"{key} diverged: an unhandled journal kind affected "
+                    "scoring — sync() must mirror it now")
