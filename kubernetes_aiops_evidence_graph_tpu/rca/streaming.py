@@ -50,17 +50,32 @@ class NeedsRebuild(Exception):
     """A bucket overflowed; the caller fell back to a full rebuild."""
 
 
-@partial(jax.jit, static_argnames=("padded_incidents", "pair_width"))
-def _tick(features, f_idx, f_rows,
-          ev_idx, ev_cnt, ev_pair, r_idx, r_ev, r_cnt, r_pair,
-          chain, padded_incidents: int, pair_width: int):
+@partial(jax.jit, static_argnames=("padded_incidents", "pair_width",
+                                   "pk", "rk", "width"))
+def _tick(features, ints, f_rows, ev_idx, ev_cnt, ev_pair,
+          chain, padded_incidents: int, pair_width: int,
+          pk: int, rk: int, width: int):
     """One fused device call per tick: scatter the padded feature delta and
     the padded evidence-row delta into the resident state, then score.
     Out-of-range indices (the padding of each delta) drop out. The caller
     replaces its state handles with the returned buffers. No buffer
     donation: the axon-tunneled backend measurably slows with donated
-    inputs, and the on-device copies are ~µs."""
+    inputs, and the on-device copies are ~µs.
+
+    All integer delta arrays arrive PACKED in one flat int32 buffer
+    (f_idx | r_idx | r_cnt | r_ev | r_pair): the dev tunnel charges
+    per-transfer latency, so 2 host→device transfers per tick (ints +
+    f_rows) beat 6 — this alone moved the full-mix streaming bench by
+    ~3 ms/tick. pk/rk/width are static, matching the bucket discipline
+    (same compiled-variant count as separate padded arrays had)."""
     from .tpu_backend import _aggregate, finish_scores
+
+    f_idx = ints[:pk]
+    r_idx = ints[pk:pk + rk]
+    r_cnt = ints[pk + rk:pk + 2 * rk]
+    off = pk + 2 * rk
+    r_ev = ints[off:off + rk * width].reshape(rk, width)
+    r_pair = ints[off + rk * width:off + 2 * rk * width].reshape(rk, width)
 
     features = features.at[f_idx].set(f_rows, mode="drop")
     ev_idx = ev_idx.at[r_idx].set(r_ev, mode="drop")
@@ -71,6 +86,11 @@ def _tick(features, f_idx, f_rows,
     counts = counts + jnp.minimum(chain, 0.0)[:, None]
     return (features, ev_idx, ev_cnt, ev_pair) + finish_scores(
         counts, per_row_max, padded_incidents)
+
+
+def _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair) -> np.ndarray:
+    return np.concatenate([f_idx, r_idx, r_cnt, r_ev.ravel(),
+                           r_pair.ravel()]).astype(np.int32, copy=False)
 
 
 class StreamingScorer:
@@ -127,14 +147,17 @@ class StreamingScorer:
         self._free_inc_rows: list[int] = list(
             range(pi - 1, snap.num_incidents - 1, -1))
 
-        # pod -> scheduled node (for pair ids of new/retargeted evidence)
+        # pod -> scheduled node (for pair ids of new/retargeted evidence),
+        # plus the reverse index node -> pods so entity removal finds its
+        # stranded pods in O(degree) instead of scanning every pod
         self._pod_node: dict[int, int] = {}
+        self._sched_pods: dict[int, set[int]] = {}
         live = snap.edge_mask > 0
         sched = live & (snap.edge_rel == int(RelationKind.SCHEDULED_ON))
         for pos in np.nonzero(sched)[0]:
             s, d = int(snap.edge_src[pos]), int(snap.edge_dst[pos])
             pod, node = (s, d) if snap.node_kind[s] == int(EntityKind.POD) else (d, s)
-            self._pod_node[pod] = node
+            self._set_pod_node(pod, node)
 
         # per-incident evidence lists + pair maps (authoritative host state)
         is_ev = live & ((snap.edge_rel == int(RelationKind.AFFECTS))
@@ -168,6 +191,9 @@ class StreamingScorer:
         self._ev_idx_dev = jnp.asarray(ev_idx)
         self._ev_cnt_dev = jnp.asarray(ev_cnt)
         self._pair_dev = jnp.asarray(ev_pair)
+        # dispatch always scores with a zero chain; cache it device-side so
+        # ticks don't pay a fresh host→device transfer for a constant
+        self._chain0 = jnp.zeros((pi,), jnp.float32)
 
         # pending deltas. The feature delta is a dict keyed by node row so
         # the LATEST update per row wins: XLA scatter-set order for
@@ -175,6 +201,31 @@ class StreamingScorer:
         # same row within one tick must collapse to one entry (ADVICE r2).
         self._pending_feat: dict[int, np.ndarray] = {}
         self._dirty_rows: set[int] = set()
+
+    def _set_pod_node(self, pod: int, node: int) -> None:
+        """Point `pod` at `node`, keeping the reverse index coherent."""
+        old = self._pod_node.get(pod)
+        if old == node:
+            return
+        if old is not None:
+            s = self._sched_pods.get(old)
+            if s is not None:
+                s.discard(pod)
+                if not s:
+                    del self._sched_pods[old]
+        self._pod_node[pod] = node
+        self._sched_pods.setdefault(node, set()).add(pod)
+
+    def _del_pod_node(self, pod: int) -> int | None:
+        """Unmap `pod`; returns its former node (reverse index updated)."""
+        node = self._pod_node.pop(pod, None)
+        if node is not None:
+            s = self._sched_pods.get(node)
+            if s is not None:
+                s.discard(pod)
+                if not s:
+                    del self._sched_pods[node]
+        return node
 
     def _append_evidence_host(self, r: int, dst: int) -> None:
         """Host bookkeeping for one evidence slot (no width checks)."""
@@ -306,13 +357,14 @@ class StreamingScorer:
             self._row_nodes[r] = [self._row_nodes[r][i] for i in keep]
             self._row_pairs[r] = [self._row_pairs[r][i] for i in keep]
             self._recompact_pairs(r)  # the slot's pair key may now be stale
-        self._pod_node.pop(row, None)
+        self._del_pod_node(row)
         # if the removed entity was a SCHEDULED_ON target, pods lose their
         # node: their evidence slots revert to the no-pair sentinel (a full
         # rebuild would see no edge). Recompacting each affected row both
         # re-stamps those slots and evicts the dead node's pair key, so a
         # future allocation can never collide with it (ADVICE r2 high).
-        stranded = [p for p, n in self._pod_node.items() if n == row]
+        # The reverse index makes this O(degree), not O(all pods).
+        stranded = self._sched_pods.pop(row, set())
         if stranded:
             affected: set[int] = set()
             for p in stranded:
@@ -424,7 +476,7 @@ class StreamingScorer:
         node = self._id_to_idx.get(node_id)
         if pod is None or node is None:
             return False
-        self._pod_node[pod] = node
+        self._set_pod_node(pod, node)
         grew = False
         for r in self._ev_rows_of_node.get(pod, set()):
             # recompact rather than setdefault(len(pm)): the pod's OLD node
@@ -454,7 +506,7 @@ class StreamingScorer:
             node = self._id_to_idx.get(node_id)
             if node is not None and self._pod_node[pod] != node:
                 return False   # already rescheduled elsewhere; stale delete
-        del self._pod_node[pod]
+        self._del_pod_node(pod)
         for r in self._ev_rows_of_node.get(pod, set()):
             self._recompact_pairs(r)
         return True
@@ -588,7 +640,6 @@ class StreamingScorer:
         pn = self.snapshot.padded_nodes
         pi = self.snapshot.padded_incidents
         dim = self.snapshot.features.shape[1]
-        chain = jnp.zeros((pi,), jnp.float32)
         cur_w = self.pair_width
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
         widths = [self.width]
@@ -611,12 +662,12 @@ class StreamingScorer:
                     r_cnt = np.zeros(rk, np.int32)
                     for pw in {cur_w, next_w}:
                         r_pair = np.full((rk, width), pw, np.int32)
+                        ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
                         res = _tick(
-                            self._features_dev, jnp.asarray(f_idx),
-                            jnp.asarray(f_rows), *tables,
-                            jnp.asarray(r_idx), jnp.asarray(r_ev),
-                            jnp.asarray(r_cnt), jnp.asarray(r_pair), chain,
-                            padded_incidents=pi, pair_width=pw)
+                            self._features_dev, jnp.asarray(ints),
+                            jnp.asarray(f_rows), *tables, self._chain0,
+                            padded_incidents=pi, pair_width=pw,
+                            pk=pk, rk=rk, width=width)
                         if width == self.width:
                             out = res
         if out is not None:   # no-op deltas; keep handles fresh
@@ -627,16 +678,16 @@ class StreamingScorer:
         """Flush pending deltas and enqueue one scoring pass; returns the
         device result handles without a host fetch (the dev tunnel charges
         ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
-        chain = jnp.zeros((self.snapshot.padded_incidents,), jnp.float32)
         f_idx, f_rows = self._pending_feature_delta()
         r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+        ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
         out = _tick(
-            self._features_dev, jnp.asarray(f_idx), jnp.asarray(f_rows),
+            self._features_dev, jnp.asarray(ints), jnp.asarray(f_rows),
             self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
-            jnp.asarray(r_idx), jnp.asarray(r_ev), jnp.asarray(r_cnt),
-            jnp.asarray(r_pair), chain,
+            self._chain0,
             padded_incidents=self.snapshot.padded_incidents,
             pair_width=self.pair_width,
+            pk=len(f_idx), rk=len(r_idx), width=self.width,
         )
         (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
          self._pair_dev) = out[:4]

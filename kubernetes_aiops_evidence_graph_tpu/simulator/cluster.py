@@ -136,6 +136,36 @@ class FakeCluster:
         call this only when *replacing* a pod under the same key."""
         self._pod_index = None
 
+    def add_pod(self, pod: PodState) -> None:
+        """Insert a pod keeping the service index incremental — a churn
+        stream at 1k events/s must not pay an O(pods) index rebuild per
+        create (the rebuild dominated the streaming bench host loop)."""
+        import bisect
+        key = self._key(pod.namespace, pod.name)
+        old = self.pods.get(key)
+        if old is not None:
+            # replacement under the same key: evict the stale object from
+            # its index list first, or it would keep serving dead state
+            self.remove_pod(pod.namespace, pod.name)
+        self.pods[key] = pod
+        if self._pod_index is not None:
+            lst = self._pod_index.setdefault((pod.namespace, pod.service), [])
+            bisect.insort(lst, pod, key=lambda p: p.name)
+            self._pod_index_size += 1
+
+    def remove_pod(self, namespace: str, name: str):
+        """Remove a pod, updating the service index in place."""
+        p = self.pods.pop(self._key(namespace, name), None)
+        if p is not None and self._pod_index is not None:
+            lst = self._pod_index.get((p.namespace, p.service))
+            if lst is not None:
+                try:
+                    lst.remove(p)       # identity-equal object reference
+                except ValueError:
+                    self._pod_index = None   # replaced object; full rebuild
+            self._pod_index_size -= 1
+        return p
+
     def _pods_by_service(self) -> dict[tuple[str, str], list[PodState]]:
         # auto-invalidate when pods were added/removed (size change); scenario
         # code mutates existing PodState objects in place, which needs no

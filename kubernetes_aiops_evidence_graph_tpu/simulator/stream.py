@@ -154,14 +154,14 @@ def apply_event(cluster: FakeCluster, event: ChurnEvent) -> list[str]:
             touched.append(f"deployment:{d.namespace}:{d.name}")
     elif event.kind == "pod_create":
         from .cluster import PodState
-        cluster.pods[key] = PodState(
+        cluster.add_pod(PodState(
             name=event.name, namespace=event.namespace,
             deployment=event.payload["deployment"],
             service=event.payload["service"], node=event.payload["node"],
-            started_at=cluster.now)
+            started_at=cluster.now))
         touched.append(f"pod:{event.namespace}:{event.name}")
     elif event.kind == "pod_delete":
-        if cluster.pods.pop(key, None) is not None:
+        if cluster.remove_pod(event.namespace, event.name) is not None:
             touched.append(f"pod:{event.namespace}:{event.name}")
     # incident_arrival / incident_close don't touch cluster state: incidents
     # live in the graph/store; stream_step() handles them there
