@@ -94,9 +94,9 @@ class Settings:
     node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)
     edge_bucket_sizes: tuple = (1024, 4096, 16384, 65536, 262144)
     incident_bucket_sizes: tuple = (8, 32, 128, 512)
-    use_pallas: bool = False                       # opt-in pallas rules kernel
-    # (measured on v5e-1 @ 50k nodes/500 incidents: XLA 0.26 ms vs pallas
-    #  0.45 ms per pass — XLA's fusion wins for this shape, so default off)
+    # NOTE: there is deliberately no pallas flag — the fused rules kernel
+    # measured at parity with the XLA path at config 3 (both ~0.2 ms/pass
+    # on v5e-1) and lives in experiments/pallas_rules.py until it wins
 
     @property
     def environment(self) -> str:

@@ -1,0 +1,6 @@
+"""Experiments — measured-but-not-winning alternatives kept for study.
+
+Code here is NOT wired into any product path or settings flag. Each module
+documents the measurement that demoted it; promotion back requires beating
+the production path on hardware at the headline config.
+"""

@@ -1,4 +1,4 @@
-"""The deterministic rules engine as ONE fused Pallas TPU kernel.
+"""EXPERIMENT — the deterministic rules engine as ONE fused Pallas kernel.
 
 The XLA path (rca/tpu_backend._score_device) lowers condition evaluation,
 rule matching and scoring to ~15 small HLO ops with [Pi, C]/[Pi, R]
@@ -14,8 +14,15 @@ constant matrices, so condition evaluation is a feature→condition matmul
 instead of per-condition column plucking (lane-dim gathers are the thing
 the MXU is bad at; selection matrices are the thing it is great at).
 
-Gated by settings.use_pallas; tests run it with interpret=True on CPU and
-assert bit-parity with the XLA path.
+Why this is an experiment, not the product path (round-4 measurement on
+TPU v5e-1, config 3 — 58k nodes / 500 incidents, chained-slope method):
+the full scoring pass costs ~0.20 ms for BOTH paths (paired in-process
+trials, each ordering: XLA 0.19-0.26 ms, Pallas 0.19-0.26 ms, ratio
+0.97-1.06x within run-to-run noise). The evidence-fold aggregation —
+shared by both paths — dominates the pass; the post-aggregation stage
+this kernel fuses is too small a fraction to move the total. Kept with
+bit-parity tests (interpret=True on CPU, tests/test_pallas_rules.py);
+promotion back requires beating _score_device at config 3 on hardware.
 """
 from __future__ import annotations
 
@@ -29,6 +36,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..graph.schema import DIM, F
+from ..rca.tpu_backend import _aggregate
 from ..rca.ruleset import (
     Cond,
     MULTIPLE_PODS_THRESHOLD,
@@ -190,3 +198,17 @@ def fused_rules_engine(counts: jax.Array, per_row_max: jax.Array,
         meta[:, 2],
         meta[:, 3],
     )
+
+
+@partial(jax.jit, static_argnames=("padded_incidents", "pair_width", "interpret"))
+def score_device_pallas(
+    features, ev_idx, ev_cnt, ev_pair_slot, chain, padded_incidents: int,
+    pair_width: int, interpret: bool = False,
+):
+    """Full scoring pass with the fused kernel tail — the experiment's
+    equivalent of rca.tpu_backend._score_device, for head-to-head benching
+    and the parity tests. Not reachable from any product setting."""
+    counts, per_row_max = _aggregate(
+        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
+    counts = counts + jnp.minimum(chain, 0.0)[:, None]
+    return fused_rules_engine(counts, per_row_max, interpret=interpret)

@@ -299,19 +299,6 @@ def _aggregate(features, ev_idx, ev_cnt, ev_pair_slot,
     return counts, per_row_max
 
 
-@partial(jax.jit, static_argnames=("padded_incidents", "pair_width", "interpret"))
-def _score_device_pallas(
-    features, ev_idx, ev_cnt, ev_pair_slot, chain, padded_incidents: int,
-    pair_width: int, interpret: bool = False,
-):
-    """Aggregation + the fused Pallas rules kernel (ops/pallas_rules.py)."""
-    from ..ops.pallas_rules import fused_rules_engine
-    counts, per_row_max = _aggregate(
-        features, ev_idx, ev_cnt, ev_pair_slot, padded_incidents, pair_width)
-    counts = counts + jnp.minimum(chain, 0.0)[:, None]  # see dispatch()
-    return fused_rules_engine(counts, per_row_max, interpret=interpret)
-
-
 @partial(jax.jit, static_argnames=("padded_incidents", "pair_width"))
 def _score_device(
     features: jax.Array,       # [Pn, DIM]
@@ -384,11 +371,7 @@ class TpuRcaBackend:
 
     name = "tpu"
 
-    def __init__(self, use_pallas: bool | None = None) -> None:
-        if use_pallas is None:
-            from ..config import get_settings
-            use_pallas = get_settings().use_pallas
-        self.use_pallas = use_pallas
+    def __init__(self) -> None:
         self._cached_snapshot: GraphSnapshot | None = None  # strong ref: keeps
         # id()s from being reused while the cache lives
         self._device_args: tuple | None = None
@@ -425,13 +408,6 @@ class TpuRcaBackend:
         batch, args, _ = self._load(snapshot)
         if chain is None:
             chain = jnp.zeros((batch.padded_incidents,), jnp.float32)
-        if self.use_pallas:
-            return _score_device_pallas(
-                *args, chain,
-                padded_incidents=batch.padded_incidents,
-                pair_width=batch.pair_width,
-                interpret=jax.default_backend() != "tpu",
-            )
         return _score_device(
             *args, chain,
             padded_incidents=batch.padded_incidents,
