@@ -88,15 +88,44 @@ class LiveClusterBackend:
         url = base + path
         if params:
             url += "?" + urllib.parse.urlencode(params)
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers={"Accept": "application/json"})
         if bearer and self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
         with urllib.request.urlopen(req, timeout=self.timeout_s,
                                     context=self._ctx if base == self.k8s_url else None) as resp:
-            return json.loads(resp.read())
+            ctype = (resp.headers.get("Content-Type") or "").split(";")[0].strip()
+            body = resp.read()
+            # a proxy/login page answering 200 text/html would otherwise
+            # surface as an inscrutable JSONDecodeError ten frames deeper
+            if ctype and "json" not in ctype:
+                raise ValueError(
+                    f"non-JSON response from {url}: Content-Type={ctype!r}, "
+                    f"body starts {body[:80]!r}")
+            return json.loads(body)
 
     def _k8s(self, path: str, params: dict[str, Any] | None = None) -> Any:
         return self._get(self.k8s_url, path, params, bearer=True)
+
+    # real API servers chunk large collections; a 50k-pod namespace comes
+    # back in pages threaded by metadata.continue (an opaque token the
+    # client must echo verbatim). The reference's kubernetes client pages
+    # transparently; this client must too or big lists silently truncate.
+    _LIST_LIMIT = 500
+
+    def _k8s_list(self, path: str,
+                  params: dict[str, Any] | None = None) -> list[dict]:
+        items: list[dict] = []
+        page = dict(params or {})
+        page["limit"] = self._LIST_LIMIT
+        while True:
+            data = self._k8s(path, page)
+            items.extend(data.get("items") or [])
+            token = (data.get("metadata") or {}).get("continue")
+            if not token:
+                return items
+            page = dict(params or {})
+            page["limit"] = self._LIST_LIMIT
+            page["continue"] = token
 
     def _k8s_write(self, method: str, path: str, payload: dict | None = None,
                    content_type: str = "application/strategic-merge-patch+json"
@@ -136,9 +165,8 @@ class LiveClusterBackend:
 
     def list_pods(self, namespace: str, service: str | None = None) -> list[PodState]:
         params = {"labelSelector": f"app={service}"} if service else None
-        data = self._k8s(f"/api/v1/namespaces/{namespace}/pods", params)
         out: list[PodState] = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(f"/api/v1/namespaces/{namespace}/pods", params):
             meta, spec, status = item["metadata"], item.get("spec", {}), item.get("status", {})
             waiting = terminated = None
             restarts = 0
@@ -177,9 +205,9 @@ class LiveClusterBackend:
 
     def list_deployments(self, namespace: str, service: str | None = None) -> list[DeploymentState]:
         params = {"labelSelector": f"app={service}"} if service else None
-        data = self._k8s(f"/apis/apps/v1/namespaces/{namespace}/deployments", params)
         out: list[DeploymentState] = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(
+                f"/apis/apps/v1/namespaces/{namespace}/deployments", params):
             meta, spec, status = item["metadata"], item.get("spec", {}), item.get("status", {})
             containers = ((spec.get("template") or {}).get("spec") or {}).get("containers") or []
             changed_at: Optional[datetime] = None
@@ -201,19 +229,17 @@ class LiveClusterBackend:
         return sorted(out, key=lambda d: d.name)
 
     def list_nodes(self) -> list[NodeState]:
-        data = self._k8s("/api/v1/nodes")
         out = []
-        for item in data.get("items", []):
+        for item in self._k8s_list("/api/v1/nodes"):
             conds = {c["type"]: c["status"]
                      for c in (item.get("status", {}).get("conditions") or [])}
             out.append(NodeState(name=item["metadata"]["name"], conditions=conds))
         return sorted(out, key=lambda n: n.name)
 
     def list_hpas(self, namespace: str, service: str | None = None) -> list[HPAState]:
-        data = self._k8s(
-            f"/apis/autoscaling/v2/namespaces/{namespace}/horizontalpodautoscalers")
         out = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(
+                f"/apis/autoscaling/v2/namespaces/{namespace}/horizontalpodautoscalers"):
             spec, status = item.get("spec", {}), item.get("status", {})
             target = (spec.get("scaleTargetRef") or {}).get("name", "")
             if service and target != service:
@@ -233,9 +259,8 @@ class LiveClusterBackend:
         return sorted(out, key=lambda h: h.name)
 
     def list_configmaps(self, namespace: str) -> list[ConfigMapState]:
-        data = self._k8s(f"/api/v1/namespaces/{namespace}/configmaps")
         out = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(f"/api/v1/namespaces/{namespace}/configmaps"):
             meta = item["metadata"]
             # K8s keeps no modification time; managedFields carries the last
             # apply time per manager (deploy_diff uses it as change signal)
@@ -248,9 +273,8 @@ class LiveClusterBackend:
         return sorted(out, key=lambda c: c.name)
 
     def list_events(self, namespace: str, since: datetime) -> list[EventState]:
-        data = self._k8s(f"/api/v1/namespaces/{namespace}/events")
         out = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(f"/api/v1/namespaces/{namespace}/events"):
             ts = item.get("lastTimestamp") or item.get("eventTime") \
                 or (item.get("metadata") or {}).get("creationTimestamp")
             when = parse_iso(ts) if ts else None
@@ -267,9 +291,9 @@ class LiveClusterBackend:
     def rollout_history(self, namespace: str, deployment: str) -> list[dict]:
         """Top-2 revisions from owned ReplicaSets (the reference's
         kubectl-rollout-history analog, deploy_diff_collector.py:270-394)."""
-        data = self._k8s(f"/apis/apps/v1/namespaces/{namespace}/replicasets")
         revisions = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(
+                f"/apis/apps/v1/namespaces/{namespace}/replicasets"):
             meta = item["metadata"]
             owners = [r.get("name") for r in meta.get("ownerReferences") or []
                       if r.get("kind") == "Deployment"]
@@ -395,9 +419,9 @@ class LiveClusterBackend:
     def rollback_deployment(self, namespace: str, name: str) -> bool:
         """Copy the previous ReplicaSet's pod template back onto the
         deployment (reference executor.py:177-234, top-2 by revision)."""
-        data = self._k8s(f"/apis/apps/v1/namespaces/{namespace}/replicasets")
         owned = []
-        for item in data.get("items", []):
+        for item in self._k8s_list(
+                f"/apis/apps/v1/namespaces/{namespace}/replicasets"):
             meta = item["metadata"]
             if any(r.get("kind") == "Deployment" and r.get("name") == name
                    for r in meta.get("ownerReferences") or []):

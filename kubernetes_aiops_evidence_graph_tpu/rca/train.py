@@ -58,34 +58,82 @@ def make_episode(num_pods: int, num_incidents: int, seed: int) -> dict:
     return gnn.snapshot_batch(snap, np.asarray(labels, dtype=np.int32))
 
 
-def make_dataset(episodes: int, num_pods: int = 96, num_incidents: int = 6,
-                 seed: int = 0) -> list[dict]:
-    return [make_episode(num_pods, num_incidents, seed + e)
+def make_dataset(episodes: int, num_pods: int | Sequence[int] = 96,
+                 num_incidents: int = 6, seed: int = 0) -> list[dict]:
+    """``num_pods`` may be a sequence of cluster sizes, cycled per episode
+    — the product-scale evaluation trains across 96→2k-pod clusters so the
+    model sees every topology bucket, not one toy size."""
+    sizes = ([num_pods] if isinstance(num_pods, int) else list(num_pods))
+    return [make_episode(sizes[e % len(sizes)], num_incidents, seed + e)
             for e in range(episodes)]
 
 
-def evaluate(params: gnn.Params, batches: Sequence[dict]) -> float:
-    """Top-1 accuracy over the labeled (masked) incidents of ``batches``."""
+def _predictions(params: gnn.Params, batches: Sequence[dict]
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """(labels, predictions) over the labeled incidents of ``batches``."""
     fwd = jax.jit(gnn.forward)   # one wrapper: compile at most once per shape
-    correct = total = 0
+    y_true, y_pred = [], []
     for b in batches:
         logits = fwd(
             params, b["features"], b["node_kind"], b["node_mask"],
             b["edge_src"], b["edge_dst"], b["edge_mask"], b["incident_nodes"])
         pred = np.asarray(logits.argmax(axis=-1))
         mask = np.asarray(b["label_mask"]) > 0
-        correct += int((pred[mask] == np.asarray(b["labels"])[mask]).sum())
-        total += int(mask.sum())
-    return correct / max(total, 1)
+        y_true.append(np.asarray(b["labels"])[mask])
+        y_pred.append(pred[mask])
+    if not y_true:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    return np.concatenate(y_true), np.concatenate(y_pred)
 
 
-def train(episodes: int = 8, steps: int = 200, num_pods: int = 96,
+def evaluate(params: gnn.Params, batches: Sequence[dict]) -> float:
+    """Top-1 accuracy over the labeled (masked) incidents of ``batches``."""
+    y, p = _predictions(params, batches)
+    return float((y == p).sum()) / max(len(y), 1)
+
+
+def confusion(params: gnn.Params, batches: Sequence[dict]) -> dict:
+    """Per-rule confusion over ``batches`` (VERDICT r3 item 5).
+
+    Returns {"matrix": [C+1][C+1] counts (row = true rule, col = predicted,
+    last index = unknown), "per_rule": {rule_id: {support, correct,
+    recall, precision}}, "accuracy": float, "incidents": int}."""
+    from .ruleset import NUM_RULES, RULES
+
+    y, p = _predictions(params, batches)
+    c = NUM_RULES + 1
+    mat = np.zeros((c, c), np.int64)
+    np.add.at(mat, (y, p), 1)
+    names = [r.id for r in RULES] + ["unknown"]
+    per_rule = {}
+    for i, name in enumerate(names):
+        support = int(mat[i].sum())
+        predicted = int(mat[:, i].sum())
+        correct = int(mat[i, i])
+        per_rule[name] = {
+            "support": support,
+            "correct": correct,
+            "recall": correct / support if support else None,
+            "precision": correct / predicted if predicted else None,
+        }
+    return {"matrix": mat.tolist(), "classes": names,
+            "per_rule": per_rule,
+            "accuracy": float((y == p).sum()) / max(len(y), 1),
+            "incidents": int(len(y))}
+
+
+def train(episodes: int = 8, steps: int = 200,
+          num_pods: int | Sequence[int] = 96,
           num_incidents: int = 6, hidden: int = 64, layers: int = 3,
           lr: float = 3e-3, seed: int = 0, eval_holdout: int = 2,
-          verbose: bool = False) -> dict:
+          with_confusion: bool = False, verbose: bool = False) -> dict:
     """Train on simulator episodes; returns params + metric history.
 
-    The last ``eval_holdout`` episodes are never trained on.
+    The last ``eval_holdout`` episodes are never trained on. The
+    product-scale evaluation recorded in BASELINE.md is
+    ``python -m ...rca.train --episodes 130 --pods 96,256,512,1024,2048
+    --incidents 8 --steps 2000 --holdout 30 --confusion`` — 1,040
+    incidents, 240 held out, class-balanced over all 10 scenarios.
     """
     import optax
 
@@ -110,12 +158,20 @@ def train(episodes: int = 8, steps: int = 200, num_pods: int = 96,
             if verbose:
                 print(f"step {s:5d} loss {float(loss):.4f}", file=sys.stderr)
 
+    # one holdout forward pass serves both accuracy and the matrix
+    holdout_cm = confusion(params, holdout) if holdout else None
     metrics = {
         "train_accuracy": evaluate(params, train_set),
-        "holdout_accuracy": evaluate(params, holdout) if holdout else None,
+        "holdout_accuracy": holdout_cm["accuracy"] if holdout_cm else None,
+        "train_incidents": sum(int(np.asarray(b["label_mask"]).sum())
+                               for b in train_set),
+        "holdout_incidents": sum(int(np.asarray(b["label_mask"]).sum())
+                                 for b in holdout),
         "final_loss": history[-1]["loss"],
         "history": history,
     }
+    if with_confusion and holdout_cm:
+        metrics["holdout_confusion"] = holdout_cm
     return {"params": params, "metrics": metrics,
             "config": {"hidden": hidden, "layers": layers}}
 
@@ -150,17 +206,27 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--episodes", type=int, default=8)
     ap.add_argument("--steps", type=int, default=200)
-    ap.add_argument("--pods", type=int, default=96)
+    ap.add_argument("--pods", default="96",
+                    help="cluster size, or comma list cycled per episode "
+                         "(e.g. 96,256,512,1024,2048)")
     ap.add_argument("--incidents", type=int, default=6)
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--layers", type=int, default=3)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--holdout", type=int, default=2)
+    ap.add_argument("--confusion", action="store_true",
+                    help="include the per-rule holdout confusion matrix")
     ap.add_argument("--checkpoint", default="", help="save trained params here")
     args = ap.parse_args(argv)
-    out = train(episodes=args.episodes, steps=args.steps, num_pods=args.pods,
+    pods: int | list[int]
+    pods = ([int(x) for x in args.pods.split(",")]
+            if "," in str(args.pods) else int(args.pods))
+    out = train(episodes=args.episodes, steps=args.steps, num_pods=pods,
                 num_incidents=args.incidents, hidden=args.hidden,
-                layers=args.layers, lr=args.lr, seed=args.seed, verbose=True)
+                layers=args.layers, lr=args.lr, seed=args.seed,
+                eval_holdout=args.holdout, with_confusion=args.confusion,
+                verbose=True)
     if args.checkpoint:
         save_checkpoint(args.checkpoint, out["params"], out["config"])
     print(json.dumps(out["metrics"]))

@@ -79,10 +79,19 @@ def test_gnn_backend_results_surface(trained):
     assert 0.0 < res.top_hypothesis.confidence <= 0.99
 
 
-def test_get_backend_gnn_requires_checkpoint(monkeypatch):
+def test_get_backend_gnn_falls_back_to_shipped_checkpoint(monkeypatch):
+    """No KAEG_GNN_CHECKPOINT -> the evaluated in-repo checkpoint loads;
+    with the shipped artifact ALSO absent the error still fires."""
     from kubernetes_aiops_evidence_graph_tpu import rca
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn_backend
+
     monkeypatch.delenv("KAEG_GNN_CHECKPOINT", raising=False)
     rca._INSTANCES.pop("gnn", None)
+    backend = get_backend("gnn")
+    assert backend.params is not None
+    rca._INSTANCES.pop("gnn", None)
+
+    monkeypatch.setattr(gnn_backend, "_shipped_checkpoint", lambda: None)
     with pytest.raises(ValueError, match="rca_backend=gnn"):
         get_backend("gnn")
     rca._INSTANCES.pop("gnn", None)
@@ -131,3 +140,52 @@ def test_unknown_top_yields_unknown_hypothesis_rank1():
 def test_train_validates_holdout_size():
     with pytest.raises(ValueError, match="must exceed eval_holdout"):
         train(episodes=2, steps=1, eval_holdout=2)
+
+
+def test_shipped_checkpoint_scores_product_scenarios(monkeypatch):
+    """The in-repo evaluated checkpoint (checkpoints/gnn, metrics in
+    GNN_EVAL.json) must load cross-platform and diagnose clear scenarios —
+    this binds the shipped artifact to CI so a stale/corrupt checkpoint
+    cannot ship silently."""
+    from pathlib import Path
+
+    # must validate THE shipped artifact, not whatever a dev's env points at
+    monkeypatch.delenv("KAEG_GNN_CHECKPOINT", raising=False)
+
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors,
+    )
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder, build_snapshot
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+        GnnRcaBackend, _shipped_checkpoint,
+    )
+    from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+    path = _shipped_checkpoint()
+    assert path is not None and Path(path).is_dir()
+
+    settings = load_settings(
+        node_bucket_sizes=(256, 512, 1024, 4096),
+        edge_bucket_sizes=(1024, 4096, 16384),
+        incident_bucket_sizes=(8, 32))
+    cluster = generate_cluster(num_pods=96, seed=3)
+    rng = np.random.default_rng(3)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    expected = {}
+    for i, name in enumerate(("crashloop_deploy", "oom", "imagepull")):
+        inc = inject(cluster, name, keys[i * 5 % len(keys)], rng)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, settings), parallel=False))
+        from kubernetes_aiops_evidence_graph_tpu.simulator import SCENARIOS
+        expected[str(inc.id)] = SCENARIOS[name].expected_rule
+    snap = build_snapshot(builder.store, settings,
+                          now_s=cluster.now.timestamp())
+
+    backend = GnnRcaBackend()   # loads the shipped checkpoint
+    results = backend.results(snap)
+    got = {str(r.incident_id): r.top_hypothesis.rule_id for r in results}
+    assert got == expected

@@ -1,0 +1,225 @@
+"""LiveClusterBackend against STRICT recorded-fixture servers.
+
+tests/test_live_backend.py proves object mapping against a permissive
+canned server; this file proves the wire discipline a REAL API server
+enforces and a permissive stub cannot catch (VERDICT r3 item 7):
+
+- Kubernetes list pagination: responses are chunked with opaque
+  ``metadata.continue`` tokens the client must echo verbatim — a client
+  that ignores them silently truncates large namespaces
+  (reference kubernetes_collector.py pages via the kubernetes client).
+- Bearer auth: requests without ``Authorization: Bearer`` are 401s.
+- Accept/Content-Type: the client sends ``Accept: application/json`` and
+  must fail loudly when a proxy/login page answers 200 text/html.
+- Selector/query encoding: labelSelector and LogQL/PromQL arrive
+  URL-encoded and must decode to exactly the intended selector.
+
+The fixture payloads in tests/fixtures/live/ follow the real wire
+envelopes: PodList with resourceVersion / remainingItemCount /
+managedFields, Prometheus {"status": "success", resultType: matrix},
+Loki resultType: streams with nanosecond-string timestamps.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.collectors.live import LiveClusterBackend
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+
+FIXTURES = Path(__file__).parent / "fixtures" / "live"
+POD_PAGES = json.loads((FIXTURES / "k8s_podlist_pages.json").read_text())
+PROM_RANGE = json.loads((FIXTURES / "prometheus_query_range.json").read_text())
+LOKI = json.loads((FIXTURES / "loki_query_range.json").read_text())
+
+TOKEN = "sa-token-f9e8d7"
+
+
+class StrictState:
+    """Per-server-instance request log + failure-injection switches."""
+
+    def __init__(self):
+        self.requests: list[dict] = []
+        self.serve_html_for: set[str] = set()
+        self.raw_queries: list[str] = []
+
+
+class _StrictHandler(BaseHTTPRequestHandler):
+    state: StrictState = None  # set per server fixture
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code: int, payload, ctype="application/json"):
+        body = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(u.query).items()}
+        self.state.requests.append(
+            {"path": u.path, "params": q,
+             "auth": self.headers.get("Authorization"),
+             "accept": self.headers.get("Accept")})
+        self.state.raw_queries.append(u.query)
+
+        if u.path in self.state.serve_html_for:
+            return self._reply(
+                200, b"<html><body>Sign in to continue</body></html>",
+                ctype="text/html")
+
+        if u.path.startswith(("/api/", "/apis/")) and "query" not in u.path:
+            # Kubernetes surface: bearer required
+            if self.headers.get("Authorization") != f"Bearer {TOKEN}":
+                return self._reply(401, {
+                    "kind": "Status", "status": "Failure", "code": 401,
+                    "reason": "Unauthorized", "message": "Unauthorized"})
+
+        if u.path == "/api/v1/namespaces/shop/pods":
+            # chunked exactly like a real apiserver: the continue token
+            # must round-trip verbatim; anything else is 410 Expired
+            token = q.get("continue")
+            if not token:
+                return self._reply(200, POD_PAGES[0])
+            for prev, page in zip(POD_PAGES, POD_PAGES[1:]):
+                if token == prev["metadata"].get("continue"):
+                    return self._reply(200, page)
+            return self._reply(410, {
+                "kind": "Status", "status": "Failure", "code": 410,
+                "reason": "Expired",
+                "message": "The provided continue parameter is too old"})
+
+        if u.path == "/api/v1/query_range":
+            return self._reply(200, PROM_RANGE)
+        if u.path == "/loki/api/v1/query_range":
+            return self._reply(200, LOKI)
+        if u.path.startswith(("/api/", "/apis/")):
+            return self._reply(200, {"kind": "List", "apiVersion": "v1",
+                                     "metadata": {}, "items": []})
+        return self._reply(404, {"error": "not found"})
+
+
+@pytest.fixture()
+def strict():
+    state = StrictState()
+    handler = type("H", (_StrictHandler,), {"state": state})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, state
+    srv.shutdown()
+
+
+def _backend(base, token=TOKEN):
+    return LiveClusterBackend(
+        load_settings(), k8s_url=base, k8s_token=token,
+        prometheus_url=base, loki_url=base)
+
+
+def test_pagination_follows_continue_tokens(strict):
+    """All three chunks are fetched and merged; each continue token is
+    echoed verbatim. A client that drops the token would return 5 of 12
+    pods and this assert would catch it."""
+    base, state = strict
+    pods = _backend(base).list_pods("shop")
+    total = sum(len(p["items"]) for p in POD_PAGES)
+    assert len(pods) == total == 12
+    # the one crashlooping pod from page 2 made it through
+    crash = [p for p in pods if p.waiting_reason == "CrashLoopBackOff"]
+    assert len(crash) == 1 and crash[0].restart_count == 9
+
+    pod_reqs = [r for r in state.requests
+                if r["path"] == "/api/v1/namespaces/shop/pods"]
+    assert len(pod_reqs) == 3
+    assert "continue" not in pod_reqs[0]["params"]
+    assert pod_reqs[1]["params"]["continue"] == \
+        POD_PAGES[0]["metadata"]["continue"]
+    assert pod_reqs[2]["params"]["continue"] == \
+        POD_PAGES[1]["metadata"]["continue"]
+    # every request carried auth + JSON accept
+    assert all(r["auth"] == f"Bearer {TOKEN}" for r in pod_reqs)
+    assert all("application/json" in (r["accept"] or "") for r in pod_reqs)
+
+
+def test_stale_continue_token_is_http_410(strict):
+    """An expired/corrupt token is a hard protocol error (410 Expired),
+    not an empty page — the client must surface it, not swallow it."""
+    base, state = strict
+    b = _backend(base)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        b._k8s_list("/api/v1/namespaces/shop/pods",
+                    {"continue": "bogus-token"})
+    assert e.value.code == 410
+
+
+def test_missing_bearer_token_is_401(strict):
+    base, state = strict
+    b = _backend(base, token=None)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        b.list_pods("shop")
+    assert e.value.code == 401
+
+
+def test_html_answer_fails_loudly(strict):
+    """A proxy/login page answering 200 text/html must raise a diagnosable
+    error at the transport, not a JSONDecodeError ten frames deeper."""
+    base, state = strict
+    state.serve_html_for.add("/api/v1/namespaces/shop/pods")
+    with pytest.raises(ValueError, match="non-JSON response.*text/html"):
+        _backend(base).list_pods("shop")
+
+
+def test_label_selector_encoding(strict):
+    """labelSelector app=checkout crosses the wire URL-encoded (%3D) and
+    decodes to exactly the intended selector."""
+    base, state = strict
+    _backend(base).list_pods("shop", "checkout")
+    req = next(r for r in state.requests
+               if r["path"] == "/api/v1/namespaces/shop/pods")
+    assert req["params"]["labelSelector"] == "app=checkout"
+    raw = state.raw_queries[state.requests.index(req)]
+    assert "labelSelector=app%3Dcheckout" in raw
+
+
+def test_loki_wire_protocol(strict):
+    """LogQL selector arrives encoded; direction/limit match the
+    reference's query (logs_collector.py:80-116); nanosecond-timestamp
+    stream values decode newest-first."""
+    base, state = strict
+    lines = _backend(base).query_logs("shop", "checkout", limit=500)
+    assert lines[0].startswith("ERROR panic: connection refused")
+    assert any("healthz" in ln for ln in lines)
+    req = next(r for r in state.requests
+               if r["path"] == "/loki/api/v1/query_range")
+    assert req["params"]["query"] == '{namespace="shop",app="checkout"}'
+    assert req["params"]["direction"] == "backward"
+    assert req["params"]["limit"] == "500"
+    raw = state.raw_queries[state.requests.index(req)]
+    assert "%7Bnamespace%3D%22shop%22" in raw   # {namespace="shop" encoded
+
+
+def test_prometheus_envelope_and_params(strict):
+    """Full success envelope (status/resultType) parses; start/end/step
+    follow the reference step formula; Inf/NaN samples are dropped."""
+    base, state = strict
+    samples = _backend(base).query_metric_range(
+        "shop", "checkout", "memory_usage_pct", 1753790000.0, 1753790400.0)
+    assert [v for _, v in samples] == [80.2, 82.1, 88.4, 90.7]
+    req = next(r for r in state.requests
+               if r["path"] == "/api/v1/query_range")
+    assert req["params"]["step"] == "15"      # max(15, 400 // 100)
+    assert req["params"]["start"] == "1753790000"
+    assert req["params"]["end"] == "1753790400"
+    assert 'namespace="shop"' in req["params"]["query"]
