@@ -13,13 +13,15 @@ see the comment in bench_rca; --calibrate validates the method against a
 known-FLOPs matmul). Accuracy is checked: top-1 must match the CPU oracle
 on every sampled incident, and the expected scenario rule overall.
 
-Prints ONE JSON line:
-  {"metric": "rca_speedup_50k_nodes_500_incidents", "value": <speedup>,
+With no args, runs ALL five BASELINE configs and prints one JSON line per
+config — serving p50 (0), 1k/20 speedup (1), label-prop (2), streaming (4)
+— with the headline config 3 LAST so a last-line consumer pins it:
+  {"metric": "rca_speedup_35000pods_500incidents", "value": <speedup>,
    "unit": "x_vs_cpu_rules_engine", "vs_baseline": <speedup>}
 
-vs_baseline is the speedup over the CPU baseline (target >= 40, BASELINE.md).
-Use --smoke for a laptop-sized run (CPU platform safe), --config N for the
-other BASELINE configs.
+vs_baseline is the ratio over each config's target (speedup target >= 40
+for config 3, BASELINE.md). Use --smoke for a laptop-sized run (CPU
+platform safe), --config N for a single config.
 """
 from __future__ import annotations
 
@@ -325,11 +327,144 @@ def bench_streaming(num_pods: int, num_incidents: int, events: int,
     return eps, statistics.median(tick_times)
 
 
+def bench_serving(num_pods: int = 200, incidents: int = 30,
+                  verbose: bool = True) -> dict:
+    """BASELINE configs[0], measured as the PRODUCT serves it: webhook →
+    12-step workflow → resident StreamingScorer (journal sync + fused
+    tick) → persisted hypotheses. Reports the end-to-end p50 per incident
+    and the serving pass's device time. This replaces the old
+    snapshot-path single-incident number, which measured a path the
+    product no longer takes. The reference's per-incident path is a
+    Temporal workflow chaining collectors → per-node Cypher MERGE loops →
+    Python rules (activities.py:26-164): seconds per incident."""
+    import math
+    import urllib.request
+
+    from kubernetes_aiops_evidence_graph_tpu.app import AiopsApp
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose else (lambda *a: None)
+    cluster = generate_cluster(num_pods=num_pods, seed=0)
+    inject(cluster, "crashloop_deploy", sorted(cluster.deployments)[0],
+           np.random.default_rng(0))
+    settings = load_settings(
+        api_port=0, db_path=":memory:", app_env="development",
+        remediation_dry_run=True, verification_wait_seconds=0,
+        rca_backend="tpu")
+    app = AiopsApp(cluster, settings)
+    port = app.start(host="127.0.0.1")
+    base = f"http://127.0.0.1:{port}"
+
+    def post_alert(name: str) -> str:
+        payload = json.dumps({"alerts": [{
+            "status": "firing",
+            "labels": {"alertname": name, "namespace": cluster.pods[
+                sorted(cluster.pods)[0]].namespace,
+                "service": sorted(cluster.deployments)[0].split("/", 1)[1],
+                "severity": "critical"},
+            "annotations": {"summary": "bench"}}]}).encode()
+        req = urllib.request.Request(
+            base + "/api/v1/webhooks/alertmanager", payload,
+            {"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req).read())["created"][0]
+
+    def serve_one(name: str, timeout_s: float = 120.0) -> float:
+        """Webhook POST → workflow completed, timed from BEFORE the POST so
+        the reported latency includes webhook handling + incident creation.
+        Fails fast on a failed workflow; retries transient status errors."""
+        t0 = time.perf_counter()
+        iid = post_alert(name)
+        while time.perf_counter() - t0 < timeout_s:
+            try:
+                with urllib.request.urlopen(
+                        f"{base}/api/v1/incidents/{iid}/status") as r:
+                    state = json.loads(r.read()).get("state")
+            except Exception:
+                time.sleep(0.05)   # transient status hiccup: retry, not abort
+                continue
+            if state == "completed":
+                return time.perf_counter() - t0
+            if state == "failed":
+                raise SystemExit(f"serving bench: incident {iid} FAILED")
+            time.sleep(0.002)
+        raise SystemExit(f"serving bench: incident {iid} never completed")
+
+    try:
+        serve_one("BenchWarmup")  # cold start: tensorize+compile
+        times = [serve_one(f"BenchServe{k}") for k in range(incidents)]
+        p50 = statistics.median(times) * 1e3
+        # nearest-rank p95: ceil(0.95 n) - 1
+        p95 = sorted(times)[max(0, math.ceil(0.95 * len(times)) - 1)] * 1e3
+        scorer = app.worker.scorer
+        raw = scorer.serve()
+        device_ms = raw["device_seconds"] * 1e3
+        modes_ok = scorer.rebuilds <= 1
+        log(f"serving: {incidents} sequential webhook incidents, "
+            f"p50 {p50:.1f} ms / p95 {p95:.1f} ms end-to-end "
+            f"(12-step workflow incl. persistence + dry-run remediation); "
+            f"serve pass device+fetch {device_ms:.1f} ms "
+            f"(~64 ms of it is the dev tunnel's fetch RTT — co-located "
+            f"hosts pay µs); rebuilds={scorer.rebuilds}")
+        if not modes_ok:
+            raise SystemExit("serving bench: scorer rebuilt mid-serve")
+        return {"p50_ms": p50, "p95_ms": p95, "device_ms": device_ms}
+    finally:
+        app.stop()
+
+
+def run_config(cfg: int, args) -> dict:
+    """Run one BASELINE config; returns the JSON record to print."""
+    if cfg == 0:
+        r = bench_serving(200, incidents=30)
+        return {
+            "metric": "serving_p50_webhook_to_hypotheses_200pods",
+            "value": round(r["p50_ms"], 1),
+            "unit": "ms end-to-end (target p50 < 100)",
+            "vs_baseline": round(100.0 / max(r["p50_ms"], 1e-9), 3),
+        }
+    if cfg == 1:
+        speedup, _, _ = bench_rca(1000, 20, 20, args.iters)
+        return {
+            "metric": "rca_speedup_1000pods_20incidents",
+            "value": round(speedup, 2),
+            "unit": "x_vs_cpu_rules_engine",
+            "vs_baseline": round(speedup, 2),
+        }
+    if cfg == 2:
+        t = bench_labelprop(10_000, args.iters)
+        return {
+            "metric": "label_propagation_10k_nodes_3hop",
+            "value": round(t * 1e3, 3),
+            "unit": "ms_per_pass",
+            "vs_baseline": 1.0,
+        }
+    if cfg == 4:
+        eps, _ = bench_streaming(10_000, 100, events=2000)
+        return {
+            "metric": "streaming_churn_events_per_sec_incl_rescoring",
+            "value": round(eps, 1),
+            "unit": "events/s (target 1000)",
+            "vs_baseline": round(eps / 1000.0, 3),
+        }
+    # config 3 — the headline: ~50k graph nodes (pods + deployments +
+    # services + nodes + hpas), 500 concurrent incidents
+    speedup, _, _ = bench_rca(35000, 500, args.cpu_sample, args.iters)
+    return {
+        "metric": "rca_speedup_35000pods_500incidents",
+        "value": round(speedup, 2),
+        "unit": "x_vs_cpu_rules_engine",
+        "vs_baseline": round(speedup, 2),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="small shapes, CPU-safe")
-    ap.add_argument("--config", type=int, default=3,
-                    help="BASELINE config index: 0=200pod/1inc 1=1k/20 3=50k/500")
+    ap.add_argument("--config", type=int, default=None,
+                    help="BASELINE config index (0=serving 1=1k/20 "
+                         "2=labelprop 3=50k/500 4=streaming); default: "
+                         "ALL five, one JSON line each, headline last")
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--cpu-sample", type=int, default=50)
     ap.add_argument("--calibrate", action="store_true",
@@ -340,45 +475,30 @@ def main(argv=None) -> int:
     if args.calibrate and platform == "tpu":
         _calibrate_slope()
 
-    if args.config == 4 and not args.smoke:
-        eps, rescore_p50 = bench_streaming(10_000, 100, events=2000)
-        print(json.dumps({
-            "metric": "streaming_churn_events_per_sec_incl_rescoring",
-            "value": round(eps, 1),
-            "unit": "events/s (target 1000)",
-            "vs_baseline": round(eps / 1000.0, 3),
-        }))
-        return 0
-
-    if args.config == 2 and not args.smoke:
-        # BASELINE configs[2]: 10k-node batched anomaly label propagation
-        t = bench_labelprop(10_000, args.iters)
-        print(json.dumps({
-            "metric": "label_propagation_10k_nodes_3hop",
-            "value": round(t * 1e3, 3),
-            "unit": "ms_per_pass",
-            "vs_baseline": 1.0,
-        }))
-        return 0
-
     if args.smoke:
-        pods, incs, sample = 200, 10, 10
-    elif args.config == 0:
-        pods, incs, sample = 200, 1, 1
-    elif args.config == 1:
-        pods, incs, sample = 1000, 20, 20
-    else:
-        # ~50k graph nodes: pods + deployments + services + nodes + hpas
-        pods, incs, sample = 35000, 500, args.cpu_sample
+        speedup, _, _ = bench_rca(200, 10, 10, args.iters)
+        print(json.dumps({
+            "metric": "rca_speedup_200pods_10incidents",
+            "value": round(speedup, 2),
+            "unit": "x_vs_cpu_rules_engine",
+            "vs_baseline": round(speedup, 2),
+        }))
+        return 0
 
-    speedup, tpu_s, _ = bench_rca(pods, incs, sample, args.iters)
-    print(json.dumps({
-        "metric": f"rca_speedup_{pods}pods_{incs}incidents",
-        "value": round(speedup, 2),
-        "unit": "x_vs_cpu_rules_engine",
-        "vs_baseline": round(speedup, 2),
-    }))
-    return 0
+    # headline (config 3) last so a last-line consumer pins it; a failure
+    # in a non-headline config emits an error record and moves on — it
+    # must never stop the headline line from printing last
+    configs = [args.config] if args.config is not None else [0, 1, 2, 4, 3]
+    rc = 0
+    for cfg in configs:
+        try:
+            rec = run_config(cfg, args)
+        except (Exception, SystemExit) as exc:
+            rec = {"metric": f"config_{cfg}_FAILED", "value": 0,
+                   "unit": "error", "vs_baseline": 0, "error": str(exc)}
+            rc = 1
+        print(json.dumps(rec), flush=True)
+    return rc
 
 
 if __name__ == "__main__":
