@@ -351,30 +351,36 @@ def bench_serving(num_pods: int = 200, incidents: int = 30,
     settings = load_settings(
         api_port=0, db_path=":memory:", app_env="development",
         remediation_dry_run=True, verification_wait_seconds=0,
-        rca_backend="tpu")
+        rca_backend="tpu",
+        # capacity-plan the incident bucket for the bench workload
+        # (warmup + sequential + concurrent ≈ 39 live incidents): a bucket
+        # overflow mid-serve re-tensorizes AND recompiles (~2 s hiccup,
+        # measured), which is an ops sizing event, not steady-state serving
+        incident_bucket_sizes=(64, 256))
     app = AiopsApp(cluster, settings)
     port = app.start(host="127.0.0.1")
     base = f"http://127.0.0.1:{port}"
 
-    def post_alert(name: str) -> str:
+    def post_alerts(*names: str) -> list[str]:
         payload = json.dumps({"alerts": [{
             "status": "firing",
             "labels": {"alertname": name, "namespace": cluster.pods[
                 sorted(cluster.pods)[0]].namespace,
                 "service": sorted(cluster.deployments)[0].split("/", 1)[1],
                 "severity": "critical"},
-            "annotations": {"summary": "bench"}}]}).encode()
+            "annotations": {"summary": "bench"}} for name in names]}).encode()
         req = urllib.request.Request(
             base + "/api/v1/webhooks/alertmanager", payload,
             {"Content-Type": "application/json"})
-        return json.loads(urllib.request.urlopen(req).read())["created"][0]
+        return json.loads(urllib.request.urlopen(req).read())["created"]
 
-    def serve_one(name: str, timeout_s: float = 120.0) -> float:
-        """Webhook POST → workflow completed, timed from BEFORE the POST so
-        the reported latency includes webhook handling + incident creation.
-        Fails fast on a failed workflow; retries transient status errors."""
+    def post_alert(name: str) -> str:
+        return post_alerts(name)[0]
+
+    def wait_done(iid: str, timeout_s: float = 120.0) -> None:
+        """Poll until the workflow completes. Fails fast on a failed
+        workflow; retries transient status errors."""
         t0 = time.perf_counter()
-        iid = post_alert(name)
         while time.perf_counter() - t0 < timeout_s:
             try:
                 with urllib.request.urlopen(
@@ -384,11 +390,18 @@ def bench_serving(num_pods: int = 200, incidents: int = 30,
                 time.sleep(0.05)   # transient status hiccup: retry, not abort
                 continue
             if state == "completed":
-                return time.perf_counter() - t0
+                return
             if state == "failed":
                 raise SystemExit(f"serving bench: incident {iid} FAILED")
             time.sleep(0.002)
         raise SystemExit(f"serving bench: incident {iid} never completed")
+
+    def serve_one(name: str, timeout_s: float = 120.0) -> float:
+        """Webhook POST → workflow completed, timed from BEFORE the POST so
+        the reported latency includes webhook handling + incident creation."""
+        t0 = time.perf_counter()
+        wait_done(post_alert(name), timeout_s)
+        return time.perf_counter() - t0
 
     try:
         serve_one("BenchWarmup")  # cold start: tensorize+compile
@@ -396,19 +409,32 @@ def bench_serving(num_pods: int = 200, incidents: int = 30,
         p50 = statistics.median(times) * 1e3
         # nearest-rank p95: ceil(0.95 n) - 1
         p95 = sorted(times)[max(0, math.ceil(0.95 * len(times)) - 1)] * 1e3
+
+        # concurrency: 8 incidents in one webhook payload race 4 worker
+        # slots; coalesced serving means the whole batch should finish in
+        # a small multiple of the solo p50, not 8x (the N callers share
+        # <=2 scorer ticks — rca/streaming.py serve())
+        t0 = time.perf_counter()
+        batch = post_alerts(*[f"BenchConc{k}" for k in range(8)])
+        for iid in batch:
+            wait_done(iid)
+        conc_wall = (time.perf_counter() - t0) * 1e3
         scorer = app.worker.scorer
         raw = scorer.serve()
         device_ms = raw["device_seconds"] * 1e3
-        modes_ok = scorer.rebuilds <= 1
+        modes_ok = scorer.rebuilds == 0   # bucket pre-sized: steady state
         log(f"serving: {incidents} sequential webhook incidents, "
             f"p50 {p50:.1f} ms / p95 {p95:.1f} ms end-to-end "
             f"(12-step workflow incl. persistence + dry-run remediation); "
-            f"serve pass device+fetch {device_ms:.1f} ms "
+            f"8 concurrent incidents complete in {conc_wall:.1f} ms wall "
+            f"({conc_wall / max(p50, 1e-9):.1f}x solo p50 — coalesced "
+            f"ticks, not 8x); serve pass device+fetch {device_ms:.1f} ms "
             f"(~64 ms of it is the dev tunnel's fetch RTT — co-located "
             f"hosts pay µs); rebuilds={scorer.rebuilds}")
         if not modes_ok:
             raise SystemExit("serving bench: scorer rebuilt mid-serve")
-        return {"p50_ms": p50, "p95_ms": p95, "device_ms": device_ms}
+        return {"p50_ms": p50, "p95_ms": p95, "device_ms": device_ms,
+                "concurrent8_wall_ms": conc_wall}
     finally:
         app.stop()
 
@@ -422,6 +448,8 @@ def run_config(cfg: int, args) -> dict:
             "value": round(r["p50_ms"], 1),
             "unit": "ms end-to-end (target p50 < 100)",
             "vs_baseline": round(100.0 / max(r["p50_ms"], 1e-9), 3),
+            "p95_ms": round(r["p95_ms"], 1),
+            "concurrent8_wall_ms": round(r["concurrent8_wall_ms"], 1),
         }
     if cfg == 1:
         speedup, _, _ = bench_rca(1000, 20, 20, args.iters)
