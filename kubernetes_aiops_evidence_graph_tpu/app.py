@@ -108,6 +108,7 @@ class AiopsApp:
                     self.worker.drain(), self._loop).result(timeout=30)
             except Exception as exc:  # drain stuck (e.g. pending approval)
                 log.warning("drain_timeout_forcing_stop", error=str(exc))
+            self.worker.stop_warm()   # idempotent; covers a stuck drain
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._loop_thread.join(timeout=5)
             self._loop = None

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Settings, get_settings
+from ..observability import get_logger
 from ..graph.schema import EntityKind, RelationKind
 from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_features
 from ..graph.store import EvidenceGraphStore
@@ -44,6 +45,8 @@ _DELTA_BUCKETS = (64, 256, 1024, 4096, 16384)
 _ROW_BUCKETS = (4, 16, 64, 256)
 
 _NO_PAIR = -1          # host-side "evidence has no scheduled node" marker
+
+log = get_logger("streaming")
 
 
 class NeedsRebuild(Exception):
@@ -103,6 +106,15 @@ class StreamingScorer:
         self.rebuilds = 0
         self.syncs = 0
         self.fetches = 0
+        # opt-in (the worker sets it): every shape change re-warms the
+        # next bucket shapes on a background thread. _warm_lock guards the
+        # active/pending/stop flags (see _rearm_warm_growth).
+        self.auto_warm_growth = False
+        self._warm_lock = threading.Lock()
+        self._warm_thread: threading.Thread | None = None
+        self._warm_active = False
+        self._warm_rearm_pending = False
+        self._warm_stop = False
         # serializes sync()+dispatch() for multi-threaded serving (workflow
         # steps run on executor threads); single-threaded benches skip it
         self.serve_lock = threading.Lock()
@@ -177,13 +189,10 @@ class StreamingScorer:
             self._append_evidence_host(r, dst)
 
         # static shapes (width also carries 1/3 slack: appended evidence
-        # must not cross a width bucket right away)
-        max_w = max(max((len(v) for v in self._row_nodes), default=1), 1)
-        self.width = bucket_for(max(int(np.ceil(max_w * 4 / 3)), 1),
-                                _WIDTH_BUCKETS)
-        self.pair_width = bucket_for(
-            max(max((len(m) for m in self._pair_map), default=1), 1),
-            _PAIR_WIDTH_BUCKETS)
+        # must not cross a width bucket right away); _rebuild_widths is the
+        # single source of this derivation so warm_growth pre-compiles the
+        # shapes a rebuild will actually land on
+        self.width, self.pair_width = self._rebuild_widths()
 
         # device state
         self._features_dev = jnp.asarray(snap.features)
@@ -306,6 +315,7 @@ class StreamingScorer:
         self._ev_cnt_dev = jnp.asarray(ev_cnt)
         self._pair_dev = jnp.asarray(ev_pair)
         self._dirty_rows.clear()
+        self._rearm_warm_growth()
 
     def _grow_pair_width(self) -> None:
         """Pair bucket overflow: bump the bucket and re-stamp sentinels.
@@ -314,10 +324,46 @@ class StreamingScorer:
         self.pair_width = bucket_for(self.pair_width + 1, _PAIR_WIDTH_BUCKETS)
         self._pair_dev = jnp.asarray(
             self._materialize_pairs(range(self.snapshot.padded_incidents)))
+        self._rearm_warm_growth()
+
+    def _rearm_warm_growth(self) -> None:
+        """After any shape change (rebuild, width or pair-width growth),
+        re-warm the growth shapes in the background so the compile-free
+        guarantee tracks the NEW current shapes, not the cold-start ones.
+        One warm thread at a time: ``_warm_active`` is flipped only under
+        ``_warm_lock`` — by this method before starting the thread and by
+        the thread itself just before exiting — so a re-arm can never race
+        a thread that already decided to exit (the pending flag is either
+        drained by the running thread or a new thread starts; no TOCTOU
+        window). NON-daemon: a daemon thread hard-killed inside an XLA
+        compile at interpreter shutdown aborts the process (observed:
+        'FATAL: exception not rethrown'); exit instead waits out at most
+        one in-flight compile (stop_warm sets the cooperative flag)."""
+        if not self.auto_warm_growth:
+            return
+        with self._warm_lock:
+            if self._warm_stop:
+                return
+            if self._warm_active:
+                self._warm_rearm_pending = True
+                return
+            self._warm_active = True
+            self._warm_rearm_pending = False
+            # daemon=False EXPLICITLY: Thread inherits the creating
+            # thread's daemon flag, and serving threads are daemons — a
+            # daemon warm thread hard-killed inside an XLA compile at
+            # interpreter shutdown aborts the process
+            self._warm_thread = threading.Thread(
+                target=self._warm_growth_quiet, name="kaeg-warm-growth",
+                daemon=False)
+            self._warm_thread.start()
 
     def _rebuild(self) -> None:
         self.rebuilds += 1
         self._init_from_store()
+        # re-arm: the guarantee "growth rebuilds never compile mid-serve"
+        # must hold for the NEXT bucket too, not just the first growth
+        self._rearm_warm_growth()
 
     # -- structural mutation API ------------------------------------------
     #
@@ -534,7 +580,17 @@ class StreamingScorer:
         affects = (int(RelationKind.AFFECTS),
                    int(RelationKind.CORRELATES_WITH))
         sched = int(RelationKind.SCHEDULED_ON)
+        rb0 = self.rebuilds
         for rec in recs:
+            if self.rebuilds != rb0:
+                # a mutation overflowed a bucket and rebuilt: the rebuild
+                # tensorized the store as of NOW — which already includes
+                # every remaining record in this batch (and advanced
+                # _synced_seq past them). Stop replaying: continuing would
+                # only re-queue redundant deltas, making the post-rebuild
+                # dispatch land on cold large delta buckets instead of the
+                # warmed minimal ones.
+                return {"applied": len(recs), "rebuilt": True}
             op = rec[1]
             if op == "node~":
                 changed.add(rec[2])
@@ -570,6 +626,8 @@ class StreamingScorer:
                     elif dst in self._inc_row_of:
                         self.remove_evidence(dst, src)
                 structural += 1
+        if self.rebuilds != rb0:   # rebuild fired on the last record
+            return {"applied": len(recs), "rebuilt": True}
         if changed:
             # applied last with CURRENT store state: latest feature wins
             # regardless of interleaving, and removed ids just skip
@@ -637,21 +695,30 @@ class StreamingScorer:
         loop either — at roughly double the warm-up compiles."""
         if not delta_sizes:
             return
-        pn = self.snapshot.padded_nodes
-        pi = self.snapshot.padded_incidents
-        dim = self.snapshot.features.shape[1]
-        cur_w = self.pair_width
+        # capture a CONSISTENT view under serve_lock (a concurrent rebuild
+        # swapping shapes mid-capture hands jit mismatched operand shapes);
+        # the expensive compiles then run outside the lock on the captured
+        # handles — read-only, so staleness is harmless
+        with self.serve_lock:
+            pn = self.snapshot.padded_nodes
+            pi = self.snapshot.padded_incidents
+            dim = self.snapshot.features.shape[1]
+            cur_w = self.pair_width
+            cur_width = self.width
+            features_dev = self._features_dev
+            cur_tables = (self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev)
+            ev_cnt_dev = self._ev_cnt_dev
+            chain0 = self._chain0
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
-        widths = [self.width]
+        widths = [cur_width]
         if include_next_width:
-            widths.append(bucket_for(self.width + 1, _WIDTH_BUCKETS))
-        out = None
+            widths.append(bucket_for(cur_width + 1, _WIDTH_BUCKETS))
         for width in widths:
-            if width == self.width:
-                tables = (self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev)
+            if width == cur_width:
+                tables = cur_tables
             else:   # stand-ins at the next width; result discarded
                 tables = (jnp.zeros((pi, width), jnp.int32),
-                          self._ev_cnt_dev,
+                          ev_cnt_dev,
                           jnp.full((pi, width), cur_w, jnp.int32))
             for pk in delta_sizes:
                 f_idx = np.full(pk, pn, dtype=np.int32)   # all-dropped deltas
@@ -661,18 +728,142 @@ class StreamingScorer:
                     r_ev = np.zeros((rk, width), np.int32)
                     r_cnt = np.zeros(rk, np.int32)
                     for pw in {cur_w, next_w}:
+                        if self._warm_stop:
+                            return
                         r_pair = np.full((rk, width), pw, np.int32)
                         ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
-                        res = _tick(
-                            self._features_dev, jnp.asarray(ints),
-                            jnp.asarray(f_rows), *tables, self._chain0,
-                            padded_incidents=pi, pair_width=pw,
-                            pk=pk, rk=rk, width=width)
-                        if width == self.width:
-                            out = res
-        if out is not None:   # no-op deltas; keep handles fresh
-            (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
-             self._pair_dev) = out[:4]
+                        _tick(features_dev, jnp.asarray(ints),
+                              jnp.asarray(f_rows), *tables, chain0,
+                              padded_incidents=pi, pair_width=pw,
+                              pk=pk, rk=rk, width=width)
+        # READ-ONLY: results discarded, resident handles untouched (no-op
+        # deltas leave the state bit-identical, and not swapping the
+        # handles is what makes warm() safe to run from a background
+        # thread concurrently with serving dispatches)
+
+    def _rebuild_widths(self) -> tuple[int, int]:
+        """(width, pair_width) a rebuild would derive from CURRENT host
+        state — mirrors _init_from_store exactly (4/3 slack on the slot
+        width, none on pairs), so warm_growth compiles the shapes the
+        rebuild will actually land on, not guesses."""
+        max_w = max(max((len(v) for v in self._row_nodes), default=1), 1)
+        width = bucket_for(max(int(np.ceil(max_w * 4 / 3)), 1),
+                           _WIDTH_BUCKETS)
+        pw = bucket_for(
+            max(max((len(m) for m in self._pair_map), default=1), 1),
+            _PAIR_WIDTH_BUCKETS)
+        return width, pw
+
+    def _growth_shape_combos(self) -> list[tuple[int, int, int, int, int]]:
+        """Snapshot, under serve_lock, the (pn, pi, width, pair_width, dim)
+        combos a rebuild could land on: what a rebuild of the CURRENT
+        store would derive (it can SHRINK after churn-down, or jump
+        multiple buckets after a burst — both store-derived here, not
+        guessed) plus one bucket of growth headroom, at the widths
+        _rebuild_widths computes and the next pair bucket
+        (_grow_pair_width can bump the current value between warm and
+        rebuild). Taking serve_lock prevents torn reads of half-rebuilt
+        host state; the expensive compiles happen outside the lock."""
+        with self.serve_lock:
+            pn, pi = self.snapshot.padded_nodes, self.snapshot.padded_incidents
+            dim = self.snapshot.features.shape[1]
+            # mirror build_snapshot(slack=1/3)'s bucket choice from store
+            # counts — what _init_from_store would land on right now
+            pn_now = bucket_for(
+                max(int(np.ceil(len(self.store._nodes) * 4 / 3)), 1),
+                self.settings.node_bucket_sizes)
+            pi_now = bucket_for(
+                max(int(np.ceil(len(self._inc_row_of) * 4 / 3)), 1),
+                self.settings.incident_bucket_sizes)
+            next_pn = bucket_for(pn + 1, self.settings.node_bucket_sizes)
+            next_pi = bucket_for(pi + 1, self.settings.incident_bucket_sizes)
+            rw, rpw = self._rebuild_widths()
+            next_pw = next((w for w in _PAIR_WIDTH_BUCKETS
+                            if w > self.pair_width), self.pair_width)
+            # the next slot-WIDTH bucket too: _grow_width (evidence-append
+            # overflow) is the remaining shape-growth axis, and it re-arms
+            # this warm but the FIRST overflow must not compile mid-serve
+            widths = {self.width, rw,
+                      bucket_for(self.width + 1, _WIDTH_BUCKETS)}
+            pws = {self.pair_width, rpw, next_pw}
+            shapes = {(pn_now, pi_now), (next_pn, pi), (pn, next_pi),
+                      (next_pn, next_pi)}
+        return [(cpn, cpi, w, pw, dim)
+                for (cpn, cpi) in shapes for w in widths for pw in pws]
+
+    def warm_growth(self) -> None:
+        """Pre-compile the fused tick at every shape a rebuild could land
+        on (see _growth_shape_combos) so a bucket-overflow rebuild
+        mid-serve pays tensorize + upload but NOT an XLA compile (~2 s
+        hiccup measured at the serving bench when uncached). The
+        post-rebuild dispatch always uses the smallest delta buckets —
+        sync() stops replaying once a rebuild fires — so only those are
+        warmed. Stand-in zero states at the target shapes are compiled and
+        discarded; the jit cache keys on shapes, so the later real rebuild
+        hits the cache. Runs on background threads (worker cold start +
+        auto re-arm on every shape change when ``auto_warm_growth`` is
+        set); stop_warm() bounds shutdown to the one in-flight compile."""
+        pk, rk = _DELTA_BUCKETS[0], _ROW_BUCKETS[0]
+        for cpn, cpi, width, pw, dim in self._growth_shape_combos():
+            if self._warm_stop:
+                return
+            tables = (jnp.zeros((cpi, width), jnp.int32),
+                      jnp.zeros((cpi,), jnp.int32),
+                      jnp.full((cpi, width), pw, jnp.int32))
+            ints = _pack_ints(
+                np.full(pk, cpn, np.int32),   # all-dropped deltas
+                np.full(rk, cpi, np.int32),
+                np.zeros(rk, np.int32),
+                np.zeros((rk, width), np.int32),
+                np.full((rk, width), pw, np.int32))
+            _tick(jnp.zeros((cpn, dim), jnp.float32), jnp.asarray(ints),
+                  jnp.zeros((pk, dim), jnp.float32), *tables,
+                  jnp.zeros((cpi,), jnp.float32),
+                  padded_incidents=cpi, pair_width=pw,
+                  pk=pk, rk=rk, width=width)
+
+    def warm_serving(self) -> None:
+        """Cold-start warm for the serving path, run off-thread by the
+        worker: steady-state delta buckets incl. the next slot-width
+        bucket (warm(), read-only) plus the growth shapes via the re-arm
+        machinery."""
+        try:
+            self.warm(delta_sizes=(64, 256), row_sizes=(4, 16),
+                      include_next_width=True)
+        except Exception as exc:
+            log.warning("warm_serving_failed", error=str(exc))
+        self._rearm_warm_growth()
+
+    def _warm_growth_quiet(self) -> None:
+        while True:
+            try:
+                self.warm_growth()
+            except Exception as exc:  # a failed pre-compile only means the
+                log.warning(          # next rebuild pays the compile itself
+                    "warm_growth_failed", error=str(exc))
+            with self._warm_lock:
+                if self._warm_stop or not self._warm_rearm_pending:
+                    self._warm_active = False
+                    return
+                self._warm_rearm_pending = False   # shapes changed mid-warm
+
+    def stop_warm(self, join: bool = True) -> None:
+        """Cooperative shutdown for the background warms: bounds process
+        exit to at most the one in-flight compile instead of the full
+        shape-combo product. Reversible — resume_warm() re-enables."""
+        with self._warm_lock:
+            self._warm_stop = True
+            self._warm_rearm_pending = False
+            t = self._warm_thread
+        if join and t is not None and t.is_alive():
+            t.join()
+
+    def resume_warm(self) -> None:
+        """Re-enable background warming after stop_warm (a worker drain
+        sets the stop flag; a later start() must not silently serve with
+        the compile-free guarantee disabled)."""
+        with self._warm_lock:
+            self._warm_stop = False
 
     def dispatch(self) -> tuple:
         """Flush pending deltas and enqueue one scoring pass; returns the

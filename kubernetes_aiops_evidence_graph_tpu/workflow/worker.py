@@ -53,6 +53,7 @@ class IncidentWorker:
         # store via its change journal — no per-incident snapshot rebuild
         self.scorer: Any = None
         self._scorer_lock = threading.Lock()
+        self._warm_thread: threading.Thread | None = None
 
     def serving_scorer(self) -> Any:
         """Lazily build the shared StreamingScorer (tpu backend only)."""
@@ -63,6 +64,16 @@ class IncidentWorker:
                 from ..rca.streaming import StreamingScorer
                 self.scorer = StreamingScorer(self.builder.store,
                                               self.settings)
+                # pre-compile the steady-state delta buckets AND the next
+                # bucket shapes off the serving path so neither hot ticks
+                # nor growth rebuilds pay an XLA compile mid-serve;
+                # auto_warm_growth re-arms after every shape change so the
+                # guarantee holds for successive growths too
+                self.scorer.auto_warm_growth = True
+                self._warm_thread = threading.Thread(
+                    target=self.scorer.warm_serving,
+                    name="kaeg-warm-serving", daemon=False)
+                self._warm_thread.start()
             return self.scorer
 
     async def submit(self, incident: Incident) -> None:
@@ -93,6 +104,11 @@ class IncidentWorker:
                 self.queue.task_done()
 
     async def start(self) -> None:
+        if self.scorer is not None:
+            # a prior drain() stopped the warms; serving is resuming, so
+            # the compile-free guarantee must resume with it
+            self.scorer.resume_warm()
+            self.scorer._rearm_warm_growth()
         self._tasks = [asyncio.create_task(self._worker_loop(i))
                        for i in range(self.concurrency)]
 
@@ -103,6 +119,17 @@ class IncidentWorker:
             await self.queue.put(None)
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        # stop_warm joins an in-flight XLA compile (seconds) — off-loop so
+        # the event loop keeps serving callbacks meanwhile
+        await asyncio.get_event_loop().run_in_executor(None, self.stop_warm)
+
+    def stop_warm(self) -> None:
+        """Cooperatively stop the background warm threads; bounded by at
+        most one in-flight XLA compile."""
+        if self.scorer is not None:
+            self.scorer.stop_warm(join=True)
+        if self._warm_thread is not None and self._warm_thread.is_alive():
+            self._warm_thread.join()
 
     async def run_all(self, incidents: list[Incident]) -> dict:
         await self.start()
