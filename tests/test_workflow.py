@@ -224,3 +224,54 @@ def test_lifecycle_routes_gnn_backend():
     finally:
         rca._INSTANCES.pop("gnn", None)
         db.close()
+
+
+def test_worker_warm_lifecycle_stops_and_resumes():
+    """The compile-free-serving warm machinery must stop cooperatively at
+    drain (bounding shutdown) and RESUME on the next start() — a worker
+    reused across run_all cycles must not silently serve with the
+    guarantee disabled (code-review regression)."""
+    tpu_settings = load_settings(
+        app_env="development", remediation_dry_run=True,
+        verification_wait_seconds=0, rca_backend="tpu",
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    cluster = generate_cluster(num_pods=96, seed=7)
+    keys = sorted(cluster.deployments)
+    rng = np.random.default_rng(7)
+    db = Database(":memory:")
+    inc1 = inject(cluster, "oom", keys[0], rng)
+    db.create_incident(inc1)
+
+    async def go():
+        worker = IncidentWorker(cluster, db, settings=tpu_settings,
+                                concurrency=2)
+        try:
+            stats1 = await worker.run_all([inc1])
+            scorer = worker.scorer
+            assert scorer is not None
+            # drain stopped the warms: flag set, no warm thread running
+            assert scorer._warm_stop
+            t = scorer._warm_thread
+            assert t is None or not t.is_alive()
+            wt = worker._warm_thread
+            assert wt is None or not wt.is_alive()
+
+            inc2 = inject(cluster, "network", keys[3], rng)
+            db.create_incident(inc2)
+            await worker.start()
+            # start() resumed the warm machinery for the second cycle
+            assert not scorer._warm_stop
+            await worker.submit(inc2)
+            await worker.drain()
+            assert scorer._warm_stop   # second drain stopped it again
+            return stats1, worker.completed
+        finally:
+            worker.stop_warm()   # no stray compile thread on assert failure
+
+    try:
+        stats1, completed = _run(go())
+        assert stats1 == {"completed": 1, "failed": 0}
+        assert completed == 2
+    finally:
+        db.close()
