@@ -100,9 +100,19 @@ class StreamingScorer:
     """Device-resident scorer with incremental structural + feature deltas."""
 
     def __init__(self, store: EvidenceGraphStore,
-                 settings: Settings | None = None) -> None:
+                 settings: Settings | None = None,
+                 mesh: "jax.sharding.Mesh | None" = None) -> None:
         self.settings = settings or get_settings()
         self.store = store
+        # optional device mesh with a "dp" axis: the resident incident
+        # tables shard over it (features replicated — every shard gathers
+        # arbitrary global node ids), so one resident scorer serves from
+        # a whole slice. GSPMD propagates the shardings through the fused
+        # tick, so outputs stay sharded across ticks with zero code
+        # changes in _tick; results are bit-identical to single-device
+        # (tests/test_streaming.py). Falls back to unsharded placement if
+        # the incident bucket is not divisible by the dp axis.
+        self.mesh = mesh
         self.rebuilds = 0
         self.syncs = 0
         self.fetches = 0
@@ -203,6 +213,7 @@ class StreamingScorer:
         # dispatch always scores with a zero chain; cache it device-side so
         # ticks don't pay a fresh host→device transfer for a constant
         self._chain0 = jnp.zeros((pi,), jnp.float32)
+        self._apply_sharding()
 
         # pending deltas. The feature delta is a dict keyed by node row so
         # the LATEST update per row wins: XLA scatter-set order for
@@ -210,6 +221,37 @@ class StreamingScorer:
         # same row within one tick must collapse to one entry (ADVICE r2).
         self._pending_feat: dict[int, np.ndarray] = {}
         self._dirty_rows: set[int] = set()
+
+    def _sharded(self, pi: int) -> bool:
+        """True when `pi` incident rows can shard over the mesh's dp axis."""
+        return (self.mesh is not None
+                and pi % self.mesh.shape["dp"] == 0)
+
+    def _shardings(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        m = self.mesh
+        return (NamedSharding(m, P()),            # replicated (features)
+                NamedSharding(m, P("dp")),        # [Pi] row vectors
+                NamedSharding(m, P("dp", None)))  # [Pi, W] row tables
+
+    def _apply_sharding(self) -> None:
+        """Place the resident state per the mesh (no-op without one).
+        Called from _init_from_store and after width growths re-materialize
+        tables; device_put with an unchanged sharding is free."""
+        if not self._sharded(self.snapshot.padded_incidents):
+            if self.mesh is not None:
+                # surface the silent single-device fallback: the operator
+                # configured a mesh but the bucket doesn't divide over it
+                log.warning("mesh_sharding_skipped",
+                            padded_incidents=self.snapshot.padded_incidents,
+                            dp=self.mesh.shape["dp"])
+            return
+        rep, row1, row2 = self._shardings()
+        self._features_dev = jax.device_put(self._features_dev, rep)
+        self._ev_idx_dev = jax.device_put(self._ev_idx_dev, row2)
+        self._ev_cnt_dev = jax.device_put(self._ev_cnt_dev, row1)
+        self._pair_dev = jax.device_put(self._pair_dev, row2)
+        self._chain0 = jax.device_put(self._chain0, row1)
 
     def _set_pod_node(self, pod: int, node: int) -> None:
         """Point `pod` at `node`, keeping the reverse index coherent."""
@@ -315,6 +357,7 @@ class StreamingScorer:
         self._ev_cnt_dev = jnp.asarray(ev_cnt)
         self._pair_dev = jnp.asarray(ev_pair)
         self._dirty_rows.clear()
+        self._apply_sharding()
         self._rearm_warm_growth()
 
     def _grow_pair_width(self) -> None:
@@ -324,6 +367,7 @@ class StreamingScorer:
         self.pair_width = bucket_for(self.pair_width + 1, _PAIR_WIDTH_BUCKETS)
         self._pair_dev = jnp.asarray(
             self._materialize_pairs(range(self.snapshot.padded_incidents)))
+        self._apply_sharding()
         self._rearm_warm_growth()
 
     def _rearm_warm_growth(self) -> None:
@@ -720,6 +764,12 @@ class StreamingScorer:
                 tables = (jnp.zeros((pi, width), jnp.int32),
                           ev_cnt_dev,
                           jnp.full((pi, width), cur_w, jnp.int32))
+                if self._sharded(pi):
+                    # compiled executables key on input shardings: the
+                    # stand-ins must match the live tables' placement
+                    _, _, row2 = self._shardings()
+                    tables = (jax.device_put(tables[0], row2), tables[1],
+                              jax.device_put(tables[2], row2))
             for pk in delta_sizes:
                 f_idx = np.full(pk, pn, dtype=np.int32)   # all-dropped deltas
                 f_rows = np.zeros((pk, dim), np.float32)
@@ -807,18 +857,28 @@ class StreamingScorer:
         for cpn, cpi, width, pw, dim in self._growth_shape_combos():
             if self._warm_stop:
                 return
+            feats = jnp.zeros((cpn, dim), jnp.float32)
             tables = (jnp.zeros((cpi, width), jnp.int32),
                       jnp.zeros((cpi,), jnp.int32),
                       jnp.full((cpi, width), pw, jnp.int32))
+            chain = jnp.zeros((cpi,), jnp.float32)
+            if self._sharded(cpi):
+                # match the placement the real rebuilt state will have:
+                # compiled executables key on input shardings
+                rep, row1, row2 = self._shardings()
+                feats = jax.device_put(feats, rep)
+                tables = (jax.device_put(tables[0], row2),
+                          jax.device_put(tables[1], row1),
+                          jax.device_put(tables[2], row2))
+                chain = jax.device_put(chain, row1)
             ints = _pack_ints(
                 np.full(pk, cpn, np.int32),   # all-dropped deltas
                 np.full(rk, cpi, np.int32),
                 np.zeros(rk, np.int32),
                 np.zeros((rk, width), np.int32),
                 np.full((rk, width), pw, np.int32))
-            _tick(jnp.zeros((cpn, dim), jnp.float32), jnp.asarray(ints),
-                  jnp.zeros((pk, dim), jnp.float32), *tables,
-                  jnp.zeros((cpi,), jnp.float32),
+            _tick(feats, jnp.asarray(ints),
+                  jnp.zeros((pk, dim), jnp.float32), *tables, chain,
                   padded_incidents=cpi, pair_width=pw,
                   pk=pk, rk=rk, width=width)
 

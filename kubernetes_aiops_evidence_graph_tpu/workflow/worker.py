@@ -63,7 +63,8 @@ class IncidentWorker:
             if self.scorer is None:
                 from ..rca.streaming import StreamingScorer
                 self.scorer = StreamingScorer(self.builder.store,
-                                              self.settings)
+                                              self.settings,
+                                              mesh=self._serving_mesh())
                 # pre-compile the steady-state delta buckets AND the next
                 # bucket shapes off the serving path so neither hot ticks
                 # nor growth rebuilds pay an XLA compile mid-serve;
@@ -75,6 +76,23 @@ class IncidentWorker:
                     name="kaeg-warm-serving", daemon=False)
                 self._warm_thread.start()
             return self.scorer
+
+    def _serving_mesh(self):
+        """settings.mesh_dp > 1 -> a dp mesh over that many devices: the
+        resident scorer's incident tables shard across the slice (see
+        StreamingScorer mesh param). None = single-device serving."""
+        dp = self.settings.mesh_dp
+        if dp <= 1:
+            return None
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+        devices = jax.devices()
+        if len(devices) < dp:
+            log.warning("mesh_dp_exceeds_devices", mesh_dp=dp,
+                        devices=len(devices))
+            return None
+        return Mesh(_np.array(devices[:dp]), ("dp",))
 
     async def submit(self, incident: Incident) -> None:
         await self.queue.put(incident)

@@ -700,3 +700,64 @@ def test_parity_survives_midstream_rebuilds(seed):
                float(ref["top_score"][i]))
          for i, iid in enumerate(ref["incident_ids"])}
     assert a == b
+
+
+def test_dp_sharded_serving_bit_equals_single_device():
+    """A StreamingScorer given a dp mesh shards its resident incident
+    tables across the (virtual 8-device) slice. Full-mix churn applied
+    incrementally to the SHARDED scorer — including a growth rebuild
+    forced by incident ingests — must stay bit-identical to a fresh
+    single-device scorer rebuilt from the same store, and the resident
+    state must stay sharded across ticks and across the rebuild (GSPMD
+    propagates output shardings; _apply_sharding re-places on rebuild)."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import stream_step
+
+    tight = load_settings(node_bucket_sizes=(512, 1024, 2048),
+                          edge_bucket_sizes=(2048, 8192, 16384),
+                          incident_bucket_sizes=(8, 32))
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+
+    cluster, builder, _ = _world(settings=tight)
+    scorer = StreamingScorer(builder.store, tight, mesh=mesh)
+    scorer.rescore()
+    row_specs = (PartitionSpec("dp"), PartitionSpec("dp", None))
+    assert scorer._ev_idx_dev.sharding.spec in row_specs
+
+    # phase 1: full-mix churn through the sharded incremental path
+    for ev in churn_events(cluster, 400, seed=5,
+                           incident_ids=tuple(builder.store.incident_ids())):
+        stream_step(cluster, builder.store, scorer, ev)
+
+    # phase 2: ingest incidents until the incident bucket overflows — the
+    # rebuild must re-place the grown state on the mesh
+    rng = np.random.default_rng(31)
+    keys = sorted(cluster.deployments)
+    k = 0
+    while scorer.rebuilds == 0:
+        k += 1
+        assert k < 40, "no rebuild after 40 ingests (premise broken)"
+        inc = inject(cluster, ("oom", "network")[k % 2],
+                     keys[(k * 3) % len(keys)], rng)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, tight), parallel=False))
+        scorer.serve()
+    assert scorer._ev_idx_dev.sharding.spec in row_specs, (
+        "rebuild lost the dp sharding")
+
+    # gold check: fresh SINGLE-DEVICE scorer over the same mutated store
+    sharded = scorer.rescore()
+    single = StreamingScorer(builder.store, tight).rescore()
+    assert set(sharded["incident_ids"]) == set(single["incident_ids"])
+    pos_a = {iid: i for i, iid in enumerate(sharded["incident_ids"])}
+    pos_b = {iid: i for i, iid in enumerate(single["incident_ids"])}
+    for iid in pos_a:
+        i, j = pos_a[iid], pos_b[iid]
+        for key in ("conditions", "matched", "scores", "top_rule_index",
+                    "any_match", "top_confidence", "top_score"):
+            np.testing.assert_array_equal(
+                np.asarray(sharded[key])[i], np.asarray(single[key])[j],
+                err_msg=f"{key} diverged for {iid} under dp mesh")
