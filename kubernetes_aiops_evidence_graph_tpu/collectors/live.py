@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import ssl
+import urllib.error
 import urllib.parse
 import urllib.request
 from datetime import datetime
@@ -114,18 +115,34 @@ class LiveClusterBackend:
 
     def _k8s_list(self, path: str,
                   params: dict[str, Any] | None = None) -> list[dict]:
-        items: list[dict] = []
-        page = dict(params or {})
-        page["limit"] = self._LIST_LIMIT
-        while True:
-            data = self._k8s(path, page)
-            items.extend(data.get("items") or [])
-            token = (data.get("metadata") or {}).get("continue")
-            if not token:
-                return items
+        # A continue token can outlive etcd compaction on a churning
+        # cluster; the API server then answers 410 Gone. The official
+        # clients relist from scratch once — do the same rather than
+        # failing the whole collection mid-listing.
+        for attempt in range(2):
+            items: list[dict] = []
             page = dict(params or {})
             page["limit"] = self._LIST_LIMIT
-            page["continue"] = token
+            minted = False  # did WE advance past the caller's first page?
+            try:
+                while True:
+                    data = self._k8s(path, page)
+                    items.extend(data.get("items") or [])
+                    token = (data.get("metadata") or {}).get("continue")
+                    if not token:
+                        return items
+                    page = dict(params or {})
+                    page["limit"] = self._LIST_LIMIT
+                    page["continue"] = token
+                    minted = True
+            except urllib.error.HTTPError as e:
+                # Relist only for tokens this loop minted mid-listing
+                # (matching the official client: an explicit caller token
+                # that is stale is the caller's protocol error to see).
+                if e.code != 410 or attempt or not minted:
+                    raise
+                self._log.warning("k8s_list_expired_continue", path=path)
+        raise AssertionError("unreachable: second attempt returns or raises")
 
     def _k8s_write(self, method: str, path: str, payload: dict | None = None,
                    content_type: str = "application/strategic-merge-patch+json"

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from functools import partial
 from typing import Iterable
 
@@ -96,6 +97,34 @@ def _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair) -> np.ndarray:
                            r_pair.ravel()]).astype(np.int32, copy=False)
 
 
+# Bound interpreter exit on ANY path, including scripts that use
+# auto_warm_growth directly and never call app.stop()/worker.drain():
+# threading._shutdown joins non-daemon threads BEFORE ordinary atexit
+# hooks run, so a plain atexit hook fires too late to stop a warm combo
+# sweep — threading._register_atexit callbacks run inside _shutdown
+# before the join (the same hook concurrent.futures relies on). One
+# module-level hook over a WeakSet, so scorer churn neither accumulates
+# callbacks nor pins dead scorers.
+_live_scorers: "weakref.WeakSet[StreamingScorer]" = weakref.WeakSet()
+_exit_hook_installed = False
+
+
+def _track_for_exit(scorer: "StreamingScorer") -> None:
+    global _exit_hook_installed
+    _live_scorers.add(scorer)
+    if not _exit_hook_installed:
+        _exit_hook_installed = True
+        try:
+            threading._register_atexit(_stop_all_warm)
+        except RuntimeError:  # interpreter already shutting down
+            pass
+
+
+def _stop_all_warm() -> None:
+    for s in list(_live_scorers):
+        s._warm_stop = True
+
+
 class StreamingScorer:
     """Device-resident scorer with incremental structural + feature deltas."""
 
@@ -125,6 +154,7 @@ class StreamingScorer:
         self._warm_active = False
         self._warm_rearm_pending = False
         self._warm_stop = False
+        _track_for_exit(self)
         # serializes sync()+dispatch() for multi-threaded serving (workflow
         # steps run on executor threads); single-threaded benches skip it
         self.serve_lock = threading.Lock()
@@ -836,8 +866,11 @@ class StreamingScorer:
             widths = {self.width, rw,
                       bucket_for(self.width + 1, _WIDTH_BUCKETS)}
             pws = {self.pair_width, rpw, next_pw}
-            shapes = {(pn_now, pi_now), (next_pn, pi), (pn, next_pi),
-                      (next_pn, next_pi)}
+            # (pn, pi) itself is included: a _grow_width overflow keeps the
+            # CURRENT node/incident shape, which after store-count drift may
+            # match none of the rebuild-derived or next buckets (ADVICE r4)
+            shapes = {(pn, pi), (pn_now, pi_now), (next_pn, pi),
+                      (pn, next_pi), (next_pn, next_pi)}
         return [(cpn, cpi, w, pw, dim)
                 for (cpn, cpi) in shapes for w in widths for pw in pws]
 

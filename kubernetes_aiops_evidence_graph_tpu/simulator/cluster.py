@@ -158,12 +158,14 @@ class FakeCluster:
         p = self.pods.pop(self._key(namespace, name), None)
         if p is not None and self._pod_index is not None:
             lst = self._pod_index.get((p.namespace, p.service))
-            if lst is not None:
+            if lst is None:
+                self._pod_index = None   # index diverged; full rebuild
+            else:
                 try:
                     lst.remove(p)       # identity-equal object reference
+                    self._pod_index_size -= 1
                 except ValueError:
                     self._pod_index = None   # replaced object; full rebuild
-            self._pod_index_size -= 1
         return p
 
     def _pods_by_service(self) -> dict[tuple[str, str], list[PodState]]:

@@ -48,6 +48,9 @@ class StrictState:
         self.requests: list[dict] = []
         self.serve_html_for: set[str] = set()
         self.raw_queries: list[str] = []
+        # one-shot: answer the next continued pod-list request with 410
+        # (etcd compaction expiring a token mid-listing)
+        self.expire_continue_once = False
 
 
 class _StrictHandler(BaseHTTPRequestHandler):
@@ -90,6 +93,12 @@ class _StrictHandler(BaseHTTPRequestHandler):
             # chunked exactly like a real apiserver: the continue token
             # must round-trip verbatim; anything else is 410 Expired
             token = q.get("continue")
+            if token and self.state.expire_continue_once:
+                self.state.expire_continue_once = False
+                return self._reply(410, {
+                    "kind": "Status", "status": "Failure", "code": 410,
+                    "reason": "Expired",
+                    "message": "The provided continue parameter is too old"})
             if not token:
                 return self._reply(200, POD_PAGES[0])
             for prev, page in zip(POD_PAGES, POD_PAGES[1:]):
@@ -162,6 +171,23 @@ def test_stale_continue_token_is_http_410(strict):
         b._k8s_list("/api/v1/namespaces/shop/pods",
                     {"continue": "bogus-token"})
     assert e.value.code == 410
+
+
+def test_mid_pagination_410_relists_once(strict):
+    """A continue token that expires MID-listing (etcd compaction on a
+    churning cluster) must trigger one relist from the beginning, not fail
+    the whole collection (ADVICE r4). The relist succeeds and returns the
+    complete, non-duplicated set."""
+    base, state = strict
+    state.expire_continue_once = True
+    pods = _backend(base).list_pods("shop")
+    total = sum(len(p["items"]) for p in POD_PAGES)
+    assert len(pods) == total == 12
+    pod_reqs = [r for r in state.requests
+                if r["path"] == "/api/v1/namespaces/shop/pods"]
+    # page0, expired page1, then a full fresh 3-page listing
+    assert len(pod_reqs) == 5
+    assert "continue" not in pod_reqs[2]["params"]
 
 
 def test_missing_bearer_token_is_401(strict):

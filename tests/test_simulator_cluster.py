@@ -1,0 +1,53 @@
+"""FakeCluster incremental service-index invariants.
+
+The index is the hot path of the streaming bench host loop; these tests pin
+the divergence cases the advisor flagged (ADVICE r4): removals that cannot
+find their index list must invalidate, never silently decrement.
+"""
+from kubernetes_aiops_evidence_graph_tpu.simulator.cluster import (
+    FakeCluster, PodState)
+
+
+def _pod(name, service="checkout"):
+    return PodState(name=name, namespace="shop", deployment=f"{service}-dep",
+                    service=service, node="n1")
+
+
+def test_remove_with_missing_index_list_invalidates_index():
+    c = FakeCluster()
+    c.add_pod(_pod("a-1"))
+    c.add_pod(_pod("a-2"))
+    c.list_pods("shop", "checkout")          # build the index
+    # simulate divergence: the (ns, service) list vanishes from the index
+    # while the pod is still in the authoritative dict
+    c._pod_index.pop(("shop", "checkout"))
+    c.remove_pod("shop", "a-1")
+    # the index must have been invalidated (not size-decremented into a
+    # consistent-looking but stale state)
+    assert [p.name for p in c.list_pods("shop", "checkout")] == ["a-2"]
+
+
+def test_remove_replaced_object_invalidates_and_recovers():
+    c = FakeCluster()
+    c.add_pod(_pod("a-1"))
+    c.list_pods("shop", "checkout")
+    # replace the object under the same key without going through add_pod
+    c.pods["shop/a-1"] = _pod("a-1")
+    c.remove_pod("shop", "a-1")
+    assert c.list_pods("shop", "checkout") == []
+
+
+def test_incremental_index_matches_full_rebuild_under_churn():
+    c = FakeCluster()
+    for i in range(6):
+        c.add_pod(_pod(f"p-{i}", service=f"svc{i % 2}"))
+    c.list_pods("shop", "svc0")
+    c.remove_pod("shop", "p-0")
+    c.add_pod(_pod("p-6", service="svc0"))
+    c.add_pod(_pod("p-2", service="svc0"))   # replacement via add_pod
+    got = {s: [p.name for p in c.list_pods("shop", s)]
+           for s in ("svc0", "svc1")}
+    c.invalidate_index()
+    want = {s: [p.name for p in c.list_pods("shop", s)]
+            for s in ("svc0", "svc1")}
+    assert got == want

@@ -761,3 +761,23 @@ def test_dp_sharded_serving_bit_equals_single_device():
             np.testing.assert_array_equal(
                 np.asarray(sharded[key])[i], np.asarray(single[key])[j],
                 err_msg=f"{key} diverged for {iid} under dp mesh")
+
+
+def test_exit_hook_stops_warm_on_all_live_scorers():
+    """The module-level _register_atexit hook must flip _warm_stop on every
+    live scorer (bounding interpreter exit to one in-flight compile) without
+    pinning dead scorers (ADVICE r4)."""
+    import gc
+    from kubernetes_aiops_evidence_graph_tpu.rca import streaming as sm
+
+    _, builder, _ = _world(num_pods=40, scenarios=("oom",))
+    a = StreamingScorer(builder.store, SMALL)
+    b = StreamingScorer(builder.store, SMALL)
+    assert a in sm._live_scorers and b in sm._live_scorers
+    del b
+    gc.collect()
+    assert not a._warm_stop
+    sm._stop_all_warm()
+    assert a._warm_stop
+    # dead scorer b was dropped from the WeakSet, not pinned
+    assert all(s is not None for s in sm._live_scorers)
