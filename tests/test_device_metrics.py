@@ -1,0 +1,88 @@
+"""Roofline instrumentation (rca/device_metrics.py): the scanned scoring
+pass must be bit-identical to the dispatched pass (otherwise the
+device-only timing measures a different program), and the accounting /
+roofline arithmetic must be self-consistent."""
+import numpy as np
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+from kubernetes_aiops_evidence_graph_tpu.rca import get_backend
+from kubernetes_aiops_evidence_graph_tpu.rca.ruleset import NUM_CONDS, NUM_RULES
+
+from tests.test_streaming import _world, SMALL
+
+
+def _snapshot():
+    from kubernetes_aiops_evidence_graph_tpu.graph import build_snapshot
+    _, builder, _ = _world(num_pods=120, scenarios=("crashloop_deploy", "oom"))
+    return build_snapshot(builder.store, SMALL)
+
+
+def test_loop_score_last_pass_bit_equals_dispatch():
+    import jax.numpy as jnp
+    snap = _snapshot()
+    tpu = get_backend("tpu")
+    ref = tpu.dispatch(snap)
+    batch = tpu.prepared(snap)
+    for k in (1, 5):
+        outs = dm._loop_score(
+            *tpu.device_arrays(snap), jnp.int32(k),
+            padded_incidents=batch.padded_incidents,
+            pair_width=batch.pair_width)
+        # the chain forces sequential passes but min(top_score, 0) == 0
+        # for real scores, so pass k == pass 1 == plain dispatch, bit
+        # for bit
+        for got, want in zip(outs, ref):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_measure_scan_per_pass_runs_and_is_positive():
+    snap = _snapshot()
+    tpu = get_backend("tpu")
+    batch = tpu.prepared(snap)
+    s = dm.measure_scan_per_pass_s(batch, tpu.device_arrays(snap), k1=2,
+                                   min_delta_s=1e-4, k_cap=64)
+    assert s > 0
+
+
+def test_fold_accounting_scales_linearly_in_width():
+    a = dm.fold_accounting(64, 16, 8, 30)
+    b = dm.fold_accounting(64, 32, 8, 30)
+    assert b["bytes"] > a["bytes"]
+    assert b["flops"] > a["flops"]
+    # the W-linear gather term dominates: doubling W nearly doubles reads
+    assert b["reads"] / a["reads"] > 1.8
+    assert a["bytes"] == a["reads"] + a["writes"]
+    # sanity against hand arithmetic for the dominant read term
+    assert a["reads"] >= 64 * 16 * 30 * 4
+
+
+def test_gnn_layer_accounting_matmul_flops_dominate():
+    acct = dm.gnn_layer_accounting(pn=4096, e=16384, hidden=64)
+    assert acct["flops"] >= 4 * 4096 * 64 * 64  # the two matmuls
+    assert acct["bytes"] == acct["reads"] + acct["writes"]
+
+
+def test_roofline_record_consistency():
+    # 1 GB at 100 GB/s = 10 ms floor; a 20 ms pass is 50% of roofline
+    rec = dm.roofline_record(int(1e9), int(1e6), 20e-3, 100.0, 1.0)
+    assert rec["bound"] == "bandwidth"
+    assert abs(rec["roofline_floor_ms"] - 10.0) < 1e-6
+    assert abs(rec["roofline_pct"] - 50.0) < 1e-6
+    assert rec["achieved_gbps"] == 50.0
+    # compute-bound case: 1 GFLOP at 1 TFLOP/s = 1 ms >> bandwidth term
+    rec2 = dm.roofline_record(1000, int(1e9), 2e-3, 100.0, 1.0)
+    assert rec2["bound"] == "compute"
+    assert abs(rec2["roofline_pct"] - 50.0) < 1e-6
+
+
+def test_gnn_forward_measure_runs():
+    snap = _snapshot()
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+    params = gnn.init_params(jax.random.PRNGKey(0), hidden=16, layers=2)
+    s = dm.measure_gnn_forward_per_pass_s(params, snap, k1=2, k2=4)
+    assert s > 0
+
+
+def test_fetch_rtt_positive():
+    assert dm.measure_fetch_rtt_ms(samples=3) >= 0
