@@ -109,73 +109,90 @@ def device_put_sharded_batch(sb: ShardedBatch, mesh: Mesh) -> tuple:
 from .sharded_gnn import _ring_perm  # noqa: E402 — shared ring permutation
 
 
+def ring_fold(blk, ev_idx, ev_cnt, ev_pair_slot, *, nodes_per_shard: int,
+              g_size: int, pair_width: int, rows_per_shard: int):
+    """Ring evidence fold over 'graph'-sharded node features.
+
+    Must run inside a shard_map whose mesh has a ``graph`` axis. ``blk`` is
+    this shard's [Pn/G, DIM] node block; the evidence tables are this
+    shard's local [rows, W] views. Each of the G steps folds the slots
+    whose GLOBAL node id lives in the currently-held block, then rotates
+    the block one hop (ppermute — the ring-attention pattern of
+    sharded_gnn). Returns ([rows, DIM] counts, [rows, pair_width]
+    pair_counts): complete after all G rotations. Shared by the batch
+    graph-sharded pass (make_graph_sharded_score) and the streaming
+    graph-sharded tick (rca/streaming.py)."""
+    from ..graph.schema import F
+    from ..rca.tpu_backend import _FOLD_CHUNK, pair_contract
+
+    my = jax.lax.axis_index("graph")
+    slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx.shape, 1)
+                 < ev_cnt[:, None]).astype(blk.dtype)         # [rows, W]
+    width = ev_idx.shape[1]
+
+    def _fold_block(h_blk, lo):
+        """Chunked fold of slots whose node id lives in [lo, lo+nps):
+        bounds the [rows, chunk, DIM] intermediate exactly like the
+        single-device _aggregate; the pair one-hot contraction rides the
+        same in-block gathered rows."""
+        def fold_slice(idx, pslot, live):
+            in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
+                      ).astype(h_blk.dtype) * live
+            local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
+            rows = h_blk[local] * in_blk[:, :, None]
+            return (rows.sum(axis=1),
+                    pair_contract(rows[:, :, F.POD_PROBLEM], pslot,
+                                  pair_width))
+
+        if width <= _FOLD_CHUNK:
+            return fold_slice(ev_idx, ev_pair_slot, slot_live)
+        def chunk_body(acc, i):
+            sl_i = jax.lax.dynamic_slice_in_dim(
+                ev_idx, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+            sl_p = jax.lax.dynamic_slice_in_dim(
+                ev_pair_slot, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+            sl_m = jax.lax.dynamic_slice_in_dim(
+                slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+            c, pc = fold_slice(sl_i, sl_p, sl_m)
+            return (acc[0] + c, acc[1] + pc), None
+        (c, pc), _ = jax.lax.scan(
+            chunk_body,
+            (jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
+             jnp.zeros((rows_per_shard, pair_width), jnp.float32)),
+            jnp.arange(width // _FOLD_CHUNK))
+        return c, pc
+
+    def body(r, carry):
+        h_blk, counts, pair_counts = carry
+        src_shard = jnp.mod(my - r, g_size)
+        lo = src_shard * nodes_per_shard
+        c, pc = _fold_block(h_blk, lo)
+        h_blk = jax.lax.ppermute(h_blk, "graph", _ring_perm(g_size))
+        return h_blk, counts + c, pair_counts + pc
+
+    _, counts, pair_counts = jax.lax.fori_loop(
+        0, g_size, body,
+        (blk,
+         jnp.zeros((rows_per_shard, blk.shape[1]), jnp.float32),
+         jnp.zeros((rows_per_shard, pair_width), jnp.float32)))
+    return counts, pair_counts
+
+
 def make_graph_sharded_score(mesh: Mesh, rows_per_shard: int,
                              nodes_per_shard: int, pair_width: int):
     """shard_map'd scoring over a (dp × graph) mesh with sharded features.
 
     fn(features_blocks [G, Pn/G, DIM], ev_idx, ev_cnt, ev_pair_slot) ->
     global [Pi, ...] outputs."""
-    from ..graph.schema import F
-    from ..rca.tpu_backend import _FOLD_CHUNK, finish_scores, pair_contract
+    from ..rca.tpu_backend import finish_scores
 
     g_size = mesh.shape["graph"]
 
     def local_score(features, ev_idx, ev_cnt, ev_pair_slot):
-        blk = features[0]                       # [Pn/G, DIM] my node block
-        ev_idx_, ev_cnt_ = ev_idx[0], ev_cnt[0]
-        pair_slot_ = ev_pair_slot[0]
-
-        my = jax.lax.axis_index("graph")
-        slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx_.shape, 1)
-                     < ev_cnt_[:, None]).astype(blk.dtype)    # [rows, W]
-        width = ev_idx_.shape[1]
-
-        def _fold_block(h_blk, lo):
-            """Chunked fold of slots whose node id lives in [lo, lo+nps):
-            bounds the [rows, chunk, DIM] intermediate exactly like the
-            single-device _aggregate; the pair one-hot contraction rides the
-            same in-block gathered rows."""
-            def fold_slice(idx, pslot, live):
-                in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
-                          ).astype(h_blk.dtype) * live
-                local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
-                rows = h_blk[local] * in_blk[:, :, None]
-                return (rows.sum(axis=1),
-                        pair_contract(rows[:, :, F.POD_PROBLEM], pslot,
-                                      pair_width))
-
-            if width <= _FOLD_CHUNK:
-                return fold_slice(ev_idx_, pair_slot_, slot_live)
-            def chunk_body(acc, i):
-                sl_i = jax.lax.dynamic_slice_in_dim(
-                    ev_idx_, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-                sl_p = jax.lax.dynamic_slice_in_dim(
-                    pair_slot_, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-                sl_m = jax.lax.dynamic_slice_in_dim(
-                    slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-                c, pc = fold_slice(sl_i, sl_p, sl_m)
-                return (acc[0] + c, acc[1] + pc), None
-            (c, pc), _ = jax.lax.scan(
-                chunk_body,
-                (jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
-                 jnp.zeros((rows_per_shard, pair_width), jnp.float32)),
-                jnp.arange(width // _FOLD_CHUNK))
-            return c, pc
-
-        def body(r, carry):
-            h_blk, counts, pair_counts = carry
-            src_shard = jnp.mod(my - r, g_size)
-            lo = src_shard * nodes_per_shard
-            c, pc = _fold_block(h_blk, lo)
-            h_blk = jax.lax.ppermute(h_blk, "graph", _ring_perm(g_size))
-            return h_blk, counts + c, pair_counts + pc
-
-        _, counts, pair_counts = jax.lax.fori_loop(
-            0, g_size, body,
-            (blk,
-             jnp.zeros((rows_per_shard, blk.shape[1]), jnp.float32),
-             jnp.zeros((rows_per_shard, pair_width), jnp.float32)))
-
+        counts, pair_counts = ring_fold(
+            features[0], ev_idx[0], ev_cnt[0], ev_pair_slot[0],
+            nodes_per_shard=nodes_per_shard, g_size=g_size,
+            pair_width=pair_width, rows_per_shard=rows_per_shard)
         per_row_max = pair_counts.max(axis=1)
         return finish_scores(counts, per_row_max, rows_per_shard)
 

@@ -36,10 +36,12 @@ def _shipped_checkpoint() -> str | None:
 class GnnRcaBackend:
     name = "gnn"
 
-    def __init__(self, params: gnn.Params | None = None) -> None:
+    def __init__(self, params: gnn.Params | None = None,
+                 settings=None) -> None:
         if params is None:
             from ..config import get_settings
-            path = get_settings().gnn_checkpoint or _shipped_checkpoint()
+            cfg = settings or get_settings()
+            path = cfg.gnn_checkpoint or _shipped_checkpoint()
             if not path:
                 raise ValueError(
                     "rca_backend=gnn needs trained parameters: set "

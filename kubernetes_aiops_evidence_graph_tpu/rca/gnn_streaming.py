@@ -110,7 +110,9 @@ class GnnStreamingScorer(StreamingScorer):
                  params: gnn.Params | None = None, mesh=None) -> None:
         if params is None:
             from .gnn_backend import GnnRcaBackend
-            params = GnnRcaBackend().params
+            # resolve the checkpoint from the settings THIS scorer was
+            # given, not the global env-derived ones (code-review r5)
+            params = GnnRcaBackend(settings=settings).params
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
         if mesh is not None:
             log.warning("gnn_streaming_mesh_unsupported")
@@ -305,10 +307,14 @@ class GnnStreamingScorer(StreamingScorer):
         }
 
     def warm_gnn(self, delta_sizes: tuple[int, ...] = (64, 256),
-                 edge_sizes: tuple[int, ...] = (64, 256)) -> None:
+                 edge_sizes: tuple[int, ...] = (64, 256, 1024)) -> None:
         """Pre-compile the GNN tick for the steady-state delta buckets so
         hot ticks never pay an XLA compile (same discipline as the base
-        warm()). All-dropped deltas: read-only, resident handles kept.
+        warm()). The edge ladder includes 1024: each pending edge packs two
+        directed entries, so a coalesced churn tick touching >128 edges
+        lands in that bucket — the serving bench does, and a mid-serve
+        compile there is the exact hiccup this exists to prevent
+        (code-review r5). All-dropped deltas: read-only, resident handles kept.
         The handles are captured under serve_lock — a concurrent rebuild
         swapping them one attribute at a time must not hand jit a mixed
         old/new shape set (same reason as base warm(), streaming.py)."""
