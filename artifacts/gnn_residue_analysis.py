@@ -1,0 +1,93 @@
+"""Reproduce and characterize the GNN holdout residue (VERDICT r4 item 4).
+
+Rebuilds the BASELINE holdout (episodes 100-129 of the 130-episode
+product-scale run), finds every GNN miss under the shipped checkpoint, and
+for each miss asks the deterministic rules oracle the same question on the
+same snapshot: if the oracle also scores the confused pair equally (or
+picks the same wrong rule), the miss is label-ambiguous by construction;
+if the oracle is right, the GNN has a feature/capacity gap.
+
+Writes artifacts/gnn_residue.json.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn, get_backend
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import GnnRcaBackend
+from kubernetes_aiops_evidence_graph_tpu.rca.ruleset import RULES
+from kubernetes_aiops_evidence_graph_tpu.rca.train import make_episode
+
+RULE_IDS = [r.id for r in RULES]
+SIZES = [96, 256, 512, 1024, 2048]
+
+
+def main() -> None:
+    params = GnnRcaBackend().params
+    fwd = jax.jit(gnn.forward)
+    backend = get_backend("tpu")
+
+    misses = []
+    total = correct = 0
+    for e in range(100, 130):
+        b = make_episode(SIZES[e % len(SIZES)], 8, seed=e,
+                         return_snapshot=True)
+        snap = b["snapshot"]
+        logits = np.asarray(fwd(
+            params, b["features"], b["node_kind"], b["node_mask"],
+            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
+            b["incident_nodes"]))
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs = probs / probs.sum(-1, keepdims=True)
+        mask = np.asarray(b["label_mask"]) > 0
+        y = np.asarray(b["labels"])
+        pred = logits.argmax(-1)
+        # rules oracle on the same snapshot
+        oracle = backend.score_snapshot(snap) if snap is not None else None
+        for i in np.nonzero(mask)[0]:
+            total += 1
+            if pred[i] == y[i]:
+                correct += 1
+                continue
+            p_sorted = np.argsort(probs[i])[::-1]
+            rec = {
+                "episode": int(e), "incident_row": int(i),
+                "true_rule": RULE_IDS[y[i]],
+                "gnn_pred": RULE_IDS[pred[i]] if pred[i] < len(RULE_IDS)
+                else "unknown",
+                "gnn_top2": [[RULE_IDS[j] if j < len(RULE_IDS) else "unknown",
+                              float(probs[i][j])] for j in p_sorted[:2]],
+            }
+            if oracle is not None:
+                oi = int(oracle["top_rule_index"][i])
+                rec["oracle_pred"] = (RULE_IDS[oi] if 0 <= oi < len(RULE_IDS)
+                                      else "unknown")
+                srow = np.asarray(oracle["scores"][i], dtype=float)
+                order = np.argsort(srow)[::-1]
+                rec["oracle_top2"] = [[RULE_IDS[j], float(srow[j])]
+                                      for j in order[:2]]
+                rec["oracle_margin"] = float(srow[order[0]] - srow[order[1]])
+            misses.append(rec)
+    out = {"holdout_incidents": total, "correct": correct,
+           "accuracy": correct / max(total, 1), "misses": misses}
+    path = os.path.join(os.path.dirname(__file__), "gnn_residue.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "misses"}))
+    for m in misses:
+        print(json.dumps(m))
+
+
+if __name__ == "__main__":
+    main()

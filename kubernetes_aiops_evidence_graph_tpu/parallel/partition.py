@@ -27,6 +27,7 @@ class PartitionedGraph:
     # graph axis: edges grouped by dst shard, dst made shard-local
     edge_src: np.ndarray        # [G, Pe_shard] global src index
     edge_dst_local: np.ndarray  # [G, Pe_shard] dst - shard*Pn/G
+    edge_rel: np.ndarray        # [G, Pe_shard] RelationKind (-1 = padding)
     edge_mask: np.ndarray       # [G, Pe_shard]
     # dp axis: incidents round-robined
     incident_nodes: np.ndarray  # [D, Pi/D] global node index
@@ -53,6 +54,7 @@ def partition_snapshot(
     live = snapshot.edge_mask > 0
     src = snapshot.edge_src[live]
     dst = snapshot.edge_dst[live]
+    rel = snapshot.edge_rel[live]
     owner = dst // nps
     counts = np.bincount(owner, minlength=graph)
     pe_shard = bucket_for(max(int(counts.max()) if counts.size else 1, 1),
@@ -60,12 +62,14 @@ def partition_snapshot(
 
     e_src = np.zeros((graph, pe_shard), np.int32)
     e_dst = np.zeros((graph, pe_shard), np.int32)
+    e_rel = np.full((graph, pe_shard), -1, np.int32)
     e_mask = np.zeros((graph, pe_shard), np.float32)
     for g in range(graph):
         sel = owner == g
         k = int(sel.sum())
         e_src[g, :k] = src[sel]
         e_dst[g, :k] = dst[sel] - g * nps
+        e_rel[g, :k] = rel[sel]
         e_mask[g, :k] = 1.0
 
     pi = snapshot.padded_incidents
@@ -85,7 +89,8 @@ def partition_snapshot(
 
     return PartitionedGraph(
         features=features, node_kind=node_kind, node_mask=node_mask,
-        edge_src=e_src, edge_dst_local=e_dst, edge_mask=e_mask,
+        edge_src=e_src, edge_dst_local=e_dst, edge_rel=e_rel,
+        edge_mask=e_mask,
         incident_nodes=inc_nodes, incident_mask=inc_mask, labels=lab,
         nodes_per_shard=nps,
     )

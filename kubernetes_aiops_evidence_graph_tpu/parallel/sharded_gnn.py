@@ -40,14 +40,17 @@ def _ring_perm(d: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % d) for i in range(d)]
 
 
-def _ring_messages(h_local, esrc, emask, edst_local, d: int):
-    """Ring halo exchange: accumulate src-side messages into local dst rows.
+def _ring_messages(h_local, esrc, erel, emask, edst_local, d: int):
+    """Ring halo exchange: accumulate src-side messages into local
+    per-(dst, relation) buckets ([nps, R, H] — the relation-aware layer
+    mixes them after the ring completes).
 
     Step r holds shard ((my - r) mod d)'s embedding block; edges whose
     global src index falls in that shard's range consume it, then the block
     rotates one hop around the ring (ppermute over 'graph')."""
     nps = h_local.shape[0]
     my = jax.lax.axis_index("graph")
+    rel = jnp.clip(erel, 0, gnn.NUM_RELS - 1)
 
     def body(r, carry):
         h_block, agg = carry
@@ -56,12 +59,14 @@ def _ring_messages(h_local, esrc, emask, edst_local, d: int):
         in_block = ((esrc >= lo) & (esrc < lo + nps)).astype(h_block.dtype)
         local_src = jnp.clip(esrc - lo, 0, nps - 1)
         msg = h_block[local_src] * (emask * in_block)[:, None]
-        agg = agg.at[edst_local].add(msg)
+        agg = agg.at[edst_local, rel].add(msg)
         h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(d))
         return h_block, agg
 
     _, agg = jax.lax.fori_loop(
-        0, d, body, (h_local, jnp.zeros_like(h_local)))
+        0, d, body,
+        (h_local, jnp.zeros((nps, gnn.NUM_RELS, h_local.shape[1]),
+                            h_local.dtype)))
     return agg
 
 
@@ -94,11 +99,12 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
         raise ValueError(f"halo must be allgather|ring, got {halo!r}")
     graph_size = mesh.shape["graph"]
 
-    def local_loss(params, feats, kind, nmask, esrc, edst_local, emask,
-                   inc_nodes, inc_mask, labels):
+    def local_loss(params, feats, kind, nmask, esrc, edst_local, erel,
+                   emask, inc_nodes, inc_mask, labels):
         # strip the leading shard axis of size 1 that shard_map hands us
         feats, kind, nmask = feats[0], kind[0], nmask[0]
-        esrc, edst_local, emask = esrc[0], edst_local[0], emask[0]
+        esrc, edst_local = esrc[0], edst_local[0]
+        erel, emask = erel[0], emask[0]
         inc_nodes, inc_mask, labels = inc_nodes[0], inc_mask[0], labels[0]
 
         # local degree of local dst nodes
@@ -110,18 +116,22 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
             feats @ params["embed_w"] + params["embed_b"] + params["kind_emb"][kind]
         ) * nmask[:, None]
 
+        rel = jnp.clip(erel, 0, gnn.NUM_RELS - 1)
         for layer in params["layers"]:
             # halo exchange: every shard needs src embeddings of its in-edges
             if halo == "ring":
-                agg = _ring_messages(h_local, esrc, emask, edst_local,
+                agg = _ring_messages(h_local, esrc, erel, emask, edst_local,
                                      graph_size)
             else:
                 h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
                 msg = h_full[esrc] * emask[:, None]
-                agg = jnp.zeros_like(h_local).at[edst_local].add(msg)
-            agg = agg * inv_deg[:, None]
+                agg = jnp.zeros(
+                    (h_local.shape[0], gnn.NUM_RELS, h_local.shape[1]),
+                    h_local.dtype).at[edst_local, rel].add(msg)
+            agg = agg * inv_deg[:, None, None]
+            mixed = jnp.einsum("nrh,rhk->nk", agg, layer["w_rel"])
             h_local = jax.nn.relu(
-                h_local @ layer["w_self"] + agg @ layer["w_msg"] + layer["b"]
+                h_local @ layer["w_self"] + mixed + layer["b"]
             ) + h_local
 
         if halo == "ring":
@@ -142,9 +152,9 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
         mesh=mesh,
         in_specs=(
             P(),                      # params replicated
-            P("graph"), P("graph"), P("graph"),          # nodes
-            P("graph"), P("graph"), P("graph"),          # edges
-            P("dp"), P("dp"), P("dp"),                   # incidents
+            P("graph"), P("graph"), P("graph"),               # nodes
+            P("graph"), P("graph"), P("graph"), P("graph"),   # edges
+            P("dp"), P("dp"), P("dp"),                        # incidents
         ),
         out_specs=P("graph"),  # per-graph-shard copy of the scalar loss
         check_vma=False,
@@ -159,10 +169,10 @@ def make_sharded_train_step(mesh: Mesh, tx, halo: str = "allgather"):
         return sharded_loss(params, *arrs).mean()
 
     @jax.jit
-    def step(params, opt_state, feats, kind, nmask, esrc, edst, emask,
-             inc_nodes, inc_mask, labels):
+    def step(params, opt_state, feats, kind, nmask, esrc, edst, erel,
+             emask, inc_nodes, inc_mask, labels):
         loss, grads = jax.value_and_grad(loss_scalar)(
-            params, feats, kind, nmask, esrc, edst, emask,
+            params, feats, kind, nmask, esrc, edst, erel, emask,
             inc_nodes, inc_mask, labels)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
@@ -178,6 +188,7 @@ def device_put_partitioned(part, mesh: Mesh) -> tuple:
     put = jax.device_put
     return (
         put(part.features, g), put(part.node_kind, g), put(part.node_mask, g),
-        put(part.edge_src, g), put(part.edge_dst_local, g), put(part.edge_mask, g),
+        put(part.edge_src, g), put(part.edge_dst_local, g),
+        put(part.edge_rel, g), put(part.edge_mask, g),
         put(part.incident_nodes, d), put(part.incident_mask, d), put(part.labels, d),
     )

@@ -77,21 +77,26 @@ def fold_accounting(pi: int, width: int, pair_width: int, dim: int,
 
 
 def gnn_layer_accounting(pn: int, e: int, hidden: int) -> dict:
-    """Minimum HBM bytes + FLOPs of one `gnn._message_pass` layer.
+    """Minimum HBM bytes + FLOPs of one `gnn._message_pass` layer
+    (relation-aware: R = gnn.NUM_RELS per-relation transforms).
 
-    reads  — message gather h[edge_src] E*H, edge mask E, inv_deg Pn,
-             h twice for the two matmuls 2*Pn*H, weights 2*H*H + H;
-    writes — segment-sum accumulator Pn*H (plus E*H read-modify-write
-             traffic for the scatter-add, counted once as E*H), layer
-             output Pn*H.
-    FLOPs — mask multiply E*H, scatter adds E*H, degree scale Pn*H, two
-            matmuls 2*2*Pn*H*H, bias+relu+residual 3*Pn*H.
+    reads  — message gather h[edge_src] E*H, edge mask + rel 2E, inv_deg
+             Pn, h twice (w_self matmul + residual) 2*Pn*H, per-relation
+             agg for the einsum Pn*R*H, weights H*H + R*H*H + H;
+    writes — per-(node, relation) accumulator Pn*R*H (plus E*H
+             read-modify-write traffic for the scatter-add, counted once
+             as E*H), mixed + layer output 2*Pn*H.
+    FLOPs — mask multiply E*H, scatter adds E*H, degree scale Pn*R*H,
+            w_self matmul 2*Pn*H*H, relation einsum 2*Pn*R*H*H,
+            bias+relu+residual 3*Pn*H.
     """
-    reads = (e * hidden + e + pn + 2 * pn * hidden
-             + 2 * hidden * hidden + hidden) * 4
-    writes = (2 * pn * hidden + e * hidden) * 4
-    flops = (2 * e * hidden + pn * hidden
-             + 4 * pn * hidden * hidden + 3 * pn * hidden)
+    from .gnn import NUM_RELS as r
+    reads = (e * hidden + 2 * e + pn + 2 * pn * hidden + pn * r * hidden
+             + hidden * hidden + r * hidden * hidden + hidden) * 4
+    writes = (pn * r * hidden + 2 * pn * hidden + e * hidden) * 4
+    flops = (2 * e * hidden + pn * r * hidden
+             + 2 * pn * hidden * hidden + 2 * pn * r * hidden * hidden
+             + 3 * pn * hidden)
     return {"bytes": reads + writes, "flops": flops,
             "reads": reads, "writes": writes}
 
@@ -246,15 +251,15 @@ def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
     b = gnn.snapshot_batch(snapshot)
     args = tuple(jnp.asarray(b[key]) for key in (
         "features", "node_kind", "node_mask", "edge_src", "edge_dst",
-        "edge_mask", "incident_nodes"))
+        "edge_rel", "edge_mask", "incident_nodes"))
 
     @partial(jax.jit, static_argnames=("k",))
     def scan_fwd(params, features, node_kind, node_mask, edge_src, edge_dst,
-                 edge_mask, incident_nodes, k: int):
+                 edge_rel, edge_mask, incident_nodes, k: int):
         def body(carry, _):
             f = features * (1.0 + carry * 1e-38)
             logits = gnn.forward(params, f, node_kind, node_mask,
-                                 edge_src, edge_dst, edge_mask,
+                                 edge_src, edge_dst, edge_rel, edge_mask,
                                  incident_nodes)
             return logits.mean(), None
         last, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)

@@ -23,8 +23,11 @@ from .tpu_backend import _incident_uuid
 
 def _shipped_checkpoint() -> str | None:
     """The repo ships an evaluated checkpoint (checkpoints/gnn; metrics in
-    GNN_EVAL.json: 98.3% top-1 on a 240-incident class-balanced holdout,
-    trained on 130 episodes across 96-2048-pod clusters) so
+    GNN_EVAL.json: relation-aware model, 98.3% top-1 on a 240-incident
+    class-balanced holdout — 99.6% on the incidents whose label is
+    derivable at all (the remainder are indistinguishable-twin incidents,
+    see holdout_crosscheck) — trained on 130 base + 130 augmented
+    episodes across 96-2048-pod clusters, 100% at 4k-8k-pod scale) so
     rca_backend=gnn works without prior training. Repo checkouts only —
     the checkpoint is not wheel package-data, so pip installs must set
     KAEG_GNN_CHECKPOINT (or train their own via rca/train.py)."""
@@ -49,6 +52,16 @@ class GnnRcaBackend:
                     "params=")
             from .train import load_checkpoint
             params = load_checkpoint(path)["params"]
+            layers = params.get("layers") or []
+            if layers and "w_rel" not in layers[0]:
+                # pre-relation-aware checkpoints (round ≤4: per-layer
+                # "w_msg") would otherwise surface as a bare KeyError deep
+                # inside jit tracing (code-review r5)
+                raise ValueError(
+                    f"checkpoint at {path} predates the relation-aware GNN "
+                    "(layers carry 'w_msg', expected 'w_rel'): retrain with "
+                    "rca/train.py or point KAEG_GNN_CHECKPOINT at a current "
+                    "checkpoint")
         self.params = params
         self._forward = jax.jit(gnn.forward)
 
@@ -57,7 +70,8 @@ class GnnRcaBackend:
         b = gnn.snapshot_batch(snapshot)
         logits = self._forward(
             self.params, b["features"], b["node_kind"], b["node_mask"],
-            b["edge_src"], b["edge_dst"], b["edge_mask"], b["incident_nodes"])
+            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
+            b["incident_nodes"])
         probs = np.asarray(jax.nn.softmax(logits, axis=-1))
         n = snapshot.num_incidents
         pred = probs.argmax(axis=-1)

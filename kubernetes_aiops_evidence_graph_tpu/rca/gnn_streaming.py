@@ -56,14 +56,14 @@ _EdgeKey = tuple[str, str, int]   # (src_id, dst_id, kind) — store edge key
 
 
 @partial(jax.jit, static_argnames=("pk", "ek", "pi"))
-def _gnn_tick(params, features, kind, nmask, esrc, edst, emask, ints,
+def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
               pk: int, ek: int, pi: int):
     """Apply the packed aux/edge deltas to the resident arrays, then run
     the full forward. One int32 transfer carries every delta (the tunnel
     charges per-transfer latency — see streaming._tick):
 
       [ f_idx pk | kind_v pk | nmask_v pk |
-        e_idx ek | e_src ek | e_dst ek | e_mask ek |
+        e_idx ek | e_src ek | e_dst ek | e_rel ek | e_mask ek |
         incident_nodes pi | incident_mask pi ]
 
     Masks ship as 0/1 ints and cast on device. Out-of-range indices (the
@@ -77,8 +77,9 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, emask, ints,
     e_idx = ints[o:o + ek]
     e_src = ints[o + ek:o + 2 * ek]
     e_dst = ints[o + 2 * ek:o + 3 * ek]
-    e_mask = ints[o + 3 * ek:o + 4 * ek].astype(jnp.float32)
-    o += 4 * ek
+    e_rel = ints[o + 3 * ek:o + 4 * ek]
+    e_mask = ints[o + 4 * ek:o + 5 * ek].astype(jnp.float32)
+    o += 5 * ek
     inc_nodes = ints[o:o + pi]
     inc_mask = ints[o + pi:o + 2 * pi].astype(jnp.float32)
 
@@ -86,14 +87,15 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, emask, ints,
     nmask = nmask.at[f_idx].set(nmask_v, mode="drop")
     esrc = esrc.at[e_idx].set(e_src, mode="drop")
     edst = edst.at[e_idx].set(e_dst, mode="drop")
+    erel = erel.at[e_idx].set(e_rel, mode="drop")
     emask = emask.at[e_idx].set(e_mask, mode="drop")
 
     logits = gnn.forward(params, features, kind, nmask,
-                         esrc, edst, emask, inc_nodes)
+                         esrc, edst, erel, emask, inc_nodes)
     probs = jax.nn.softmax(logits, axis=-1)
     # mask dead incident rows so a stale row can never surface a score
     probs = probs * inc_mask[:, None]
-    return kind, nmask, esrc, edst, emask, logits, probs
+    return kind, nmask, esrc, edst, erel, emask, logits, probs
 
 
 class GnnStreamingScorer(StreamingScorer):
@@ -139,6 +141,7 @@ class GnnStreamingScorer(StreamingScorer):
         pe = bucket_for(need, self.settings.edge_bucket_sizes)
         esrc = np.zeros(pe, np.int32)
         edst = np.zeros(pe, np.int32)
+        erel = np.full(pe, -1, np.int32)
         emask = np.zeros(pe, np.float32)
         self._edge_slot: dict[_EdgeKey, int] = {}
         self._node_edges: dict[str, set[_EdgeKey]] = {}
@@ -151,6 +154,7 @@ class GnnStreamingScorer(StreamingScorer):
             key = (e.src, e.dst, int(e.kind))
             esrc[slot], edst[slot], emask[slot] = srow, drow, 1.0
             esrc[slot + 1], edst[slot + 1], emask[slot + 1] = drow, srow, 1.0
+            erel[slot] = erel[slot + 1] = int(e.kind)
             self._edge_slot[key] = slot
             self._node_edges.setdefault(e.src, set()).add(key)
             self._node_edges.setdefault(e.dst, set()).add(key)
@@ -158,10 +162,12 @@ class GnnStreamingScorer(StreamingScorer):
         self._free_edge_slots: list[int] = list(range(pe - 2, slot - 2, -2))
         self._esrc_dev = jnp.asarray(esrc)
         self._edst_dev = jnp.asarray(edst)
+        self._erel_dev = jnp.asarray(erel)
         self._emask_dev = jnp.asarray(emask)
         self._kind_dev = jnp.asarray(self.snapshot.node_kind)
         self._nmask_dev = jnp.asarray(self.snapshot.node_mask)
-        self._pending_edges: dict[int, tuple[int, int, int]] = {}
+        # slot -> (src_row, dst_row, rel_kind, mask)
+        self._pending_edges: dict[int, tuple[int, int, int, int]] = {}
         self._last_gnn: tuple | None = None
 
     # -- journal-driven mirror maintenance --------------------------------
@@ -181,7 +187,7 @@ class GnnStreamingScorer(StreamingScorer):
         self._edge_slot[key] = slot
         self._node_edges.setdefault(src, set()).add(key)
         self._node_edges.setdefault(dst, set()).add(key)
-        self._pending_edges[slot] = (srow, drow, 1)
+        self._pending_edges[slot] = (srow, drow, kind, 1)
 
     def _mirror_del(self, key: _EdgeKey) -> None:
         slot = self._edge_slot.pop(key, None)
@@ -195,7 +201,7 @@ class GnnStreamingScorer(StreamingScorer):
                 if not s:
                     del self._node_edges[nid]
         self._free_edge_slots.append(slot)
-        self._pending_edges[slot] = (0, 0, 0)
+        self._pending_edges[slot] = (0, 0, -1, 0)
 
     def _drain_edges(self) -> None:
         recs, seq, truncated = self.store.journal_since(self._gnn_seq)
@@ -235,9 +241,9 @@ class GnnStreamingScorer(StreamingScorer):
                 aux_rows].astype(np.int32)
 
         ents = []
-        for slot, (srow, drow, m) in self._pending_edges.items():
-            ents.append((slot, srow, drow, m))        # forward direction
-            ents.append((slot + 1, drow, srow, m))    # reverse direction
+        for slot, (srow, drow, rel, m) in self._pending_edges.items():
+            ents.append((slot, srow, drow, rel, m))       # forward direction
+            ents.append((slot + 1, drow, srow, rel, m))   # reverse direction
         self._pending_edges = {}
         if len(ents) > _DELTA_BUCKETS[-1]:
             # a delta beyond the ladder would mint a fresh power-of-two
@@ -253,12 +259,14 @@ class GnnStreamingScorer(StreamingScorer):
         e_idx = np.full(ek, pe, np.int32)
         e_src = np.zeros(ek, np.int32)
         e_dst = np.zeros(ek, np.int32)
+        e_rel = np.full(ek, -1, np.int32)
         e_mask = np.zeros(ek, np.int32)
-        for j, (slot, s, d, m) in enumerate(ents):
-            e_idx[j], e_src[j], e_dst[j], e_mask[j] = slot, s, d, m
+        for j, (slot, s, d, r, m) in enumerate(ents):
+            e_idx[j], e_src[j], e_dst[j] = slot, s, d
+            e_rel[j], e_mask[j] = r, m
 
         ints = np.concatenate([
-            f_idx, kind_v, nmask_v, e_idx, e_src, e_dst, e_mask,
+            f_idx, kind_v, nmask_v, e_idx, e_src, e_dst, e_rel, e_mask,
             self.snapshot.incident_nodes.astype(np.int32),
             self.snapshot.incident_mask.astype(np.int32),
         ]).astype(np.int32, copy=False)
@@ -273,10 +281,10 @@ class GnnStreamingScorer(StreamingScorer):
         self._drain_edges()
         ints, pk, ek = self._packed_gnn_delta(aux_rows)
         (self._kind_dev, self._nmask_dev, self._esrc_dev, self._edst_dev,
-         self._emask_dev, logits, probs) = _gnn_tick(
+         self._erel_dev, self._emask_dev, logits, probs) = _gnn_tick(
             self._params, self._features_dev, self._kind_dev,
             self._nmask_dev, self._esrc_dev, self._edst_dev,
-            self._emask_dev, jnp.asarray(ints),
+            self._erel_dev, self._emask_dev, jnp.asarray(ints),
             pk=pk, ek=ek, pi=self.snapshot.padded_incidents)
         self._last_gnn = (logits, probs)
         return out
@@ -324,7 +332,7 @@ class GnnStreamingScorer(StreamingScorer):
             pe = int(self._esrc_dev.shape[0])
             handles = (self._params, self._features_dev, self._kind_dev,
                        self._nmask_dev, self._esrc_dev, self._edst_dev,
-                       self._emask_dev)
+                       self._erel_dev, self._emask_dev)
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
             inc_m = self.snapshot.incident_mask.astype(np.int32)
         for pk in delta_sizes:
@@ -335,7 +343,8 @@ class GnnStreamingScorer(StreamingScorer):
                     np.full(pk, pn, np.int32), np.zeros(pk, np.int32),
                     np.zeros(pk, np.int32),
                     np.full(ek, pe, np.int32), np.zeros(ek, np.int32),
-                    np.zeros(ek, np.int32), np.zeros(ek, np.int32),
+                    np.zeros(ek, np.int32), np.full(ek, -1, np.int32),
+                    np.zeros(ek, np.int32),
                     inc_n, inc_m,
                 ]).astype(np.int32, copy=False)
                 _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek, pi=pi)
@@ -367,7 +376,8 @@ class GnnStreamingScorer(StreamingScorer):
                     np.full(pk, cpn, np.int32), np.zeros(pk, np.int32),
                     np.zeros(pk, np.int32),
                     np.full(ek, cpe, np.int32), np.zeros(ek, np.int32),
-                    np.zeros(ek, np.int32), np.zeros(ek, np.int32),
+                    np.zeros(ek, np.int32), np.full(ek, -1, np.int32),
+                    np.zeros(ek, np.int32),
                     np.zeros(2 * cpi, np.int32),
                 ]).astype(np.int32, copy=False)
                 _gnn_tick(self._params,
@@ -376,6 +386,7 @@ class GnnStreamingScorer(StreamingScorer):
                           jnp.zeros(cpn, jnp.float32),
                           jnp.zeros(cpe, jnp.int32),
                           jnp.zeros(cpe, jnp.int32),
+                          jnp.full((cpe,), -1, jnp.int32),
                           jnp.zeros(cpe, jnp.float32),
                           jnp.asarray(ints), pk=pk, ek=ek, pi=cpi)
 

@@ -44,7 +44,8 @@ def test_gnn_forward_runs_and_masks():
     batch = gnn.snapshot_batch(snapshot, labels)
     logits = gnn.forward(params, batch["features"], batch["node_kind"],
                          batch["node_mask"], batch["edge_src"], batch["edge_dst"],
-                         batch["edge_mask"], batch["incident_nodes"])
+                         batch["edge_rel"], batch["edge_mask"],
+                         batch["incident_nodes"])
     assert logits.shape == (snapshot.padded_incidents, gnn.NUM_CLASSES)
     assert np.isfinite(np.asarray(logits)).all()
 
@@ -74,7 +75,8 @@ def test_sharded_matches_single_device_loss():
     batch = gnn.snapshot_batch(snapshot, labels)
     single = float(gnn.loss_fn(
         params, batch["features"], batch["node_kind"], batch["node_mask"],
-        batch["edge_src"], batch["edge_dst"], batch["edge_mask"],
+        batch["edge_src"], batch["edge_dst"], batch["edge_rel"],
+        batch["edge_mask"],
         batch["incident_nodes"], batch["labels"], batch["label_mask"]))
 
     mesh = make_mesh(dp=4, graph=2)
