@@ -39,6 +39,36 @@ def pod_signal_strength(waiting: str | None, terminated: str | None,
     return 0.3
 
 
+def pod_detail(p) -> dict:
+    """Review-surface pod detail (reference kubernetes_collector.py:194-267
+    payload shape): per-container conditions / state / last-state /
+    resources. Backends that read the wire (collectors/live.py) attach the
+    real data on PodState; for backends that only track scalars (the fake
+    cluster) this synthesizes the equivalent one-container view, so
+    runbooks, tickets and graph-API consumers see the same payload shape
+    either way (VERDICT r4 item 7)."""
+    if p.container_statuses is not None:
+        return {"conditions": p.conditions or [],
+                "container_statuses": p.container_statuses,
+                "resources": p.resources or {},
+                "labels": p.labels or {}}
+    status: dict = {"name": "app", "ready": p.ready,
+                    "restart_count": p.restart_count}
+    if p.waiting_reason:
+        status["waiting"] = {"reason": p.waiting_reason, "message": None}
+    if p.terminated_reason:
+        # scalar state keeps only the reason; a restarting container
+        # reports it as last-state (the live path distinguishes both)
+        status["last_terminated"] = {"reason": p.terminated_reason,
+                                     "exit_code": 137
+                                     if p.terminated_reason == "OOMKilled"
+                                     else 1}
+    ready_cond = {"type": "Ready", "status": "True" if p.ready else "False",
+                  "reason": None}
+    return {"conditions": [ready_cond], "container_statuses": [status],
+            "resources": {}, "labels": {"app": p.service}}
+
+
 class KubernetesCollector(BaseCollector):
     name = "kubernetes"
     source = EvidenceSource.KUBERNETES_API
@@ -68,6 +98,9 @@ class KubernetesCollector(BaseCollector):
                 "readiness_probe_failing": p.readiness_probe_failing,
                 "phase": p.phase,
                 "node": p.node,
+                "created_at": p.started_at.isoformat()
+                if p.started_at else None,
+                **pod_detail(p),
             }
             result.evidence.append(self.make_evidence(
                 incident, EvidenceType.KUBERNETES_POD, p.name, data,

@@ -188,12 +188,32 @@ class LiveClusterBackend:
             waiting = terminated = None
             restarts = 0
             probe_failing = False
+            statuses: list[dict] = []
             for cs in status.get("containerStatuses") or []:
                 restarts += int(cs.get("restartCount", 0))
                 state = cs.get("state") or {}
-                if "waiting" in state and waiting is None:
-                    waiting = state["waiting"].get("reason")
-                last = (cs.get("lastState") or {}).get("terminated") or state.get("terminated")
+                # per-container review detail, the reference's payload shape
+                # (kubernetes_collector.py:218-245)
+                sinfo: dict = {"name": cs.get("name", ""),
+                               "ready": bool(cs.get("ready", False)),
+                               "restart_count": int(cs.get("restartCount", 0))}
+                if "waiting" in state:
+                    sinfo["waiting"] = {
+                        "reason": state["waiting"].get("reason"),
+                        "message": state["waiting"].get("message")}
+                    if waiting is None:
+                        waiting = state["waiting"].get("reason")
+                if "terminated" in state:
+                    sinfo["terminated"] = {
+                        "reason": state["terminated"].get("reason"),
+                        "exit_code": state["terminated"].get("exitCode")}
+                lt = (cs.get("lastState") or {}).get("terminated")
+                if lt:
+                    sinfo["last_terminated"] = {
+                        "reason": lt.get("reason"),
+                        "exit_code": lt.get("exitCode")}
+                statuses.append(sinfo)
+                last = lt or state.get("terminated")
                 if last and terminated is None:
                     terminated = last.get("reason")
                 if "running" in state and not cs.get("ready", True):
@@ -206,6 +226,10 @@ class LiveClusterBackend:
                     if not ready and cond.get("lastTransitionTime"):
                         not_ready_s = max(0.0, (utcnow() - parse_iso(
                             cond["lastTransitionTime"])).total_seconds())
+            resources = {
+                c["name"]: {"requests": (c.get("resources") or {}).get("requests"),
+                            "limits": (c.get("resources") or {}).get("limits")}
+                for c in spec.get("containers") or [] if c.get("resources")}
             out.append(PodState(
                 name=meta["name"], namespace=namespace,
                 deployment=self._owner_deployment(meta) or self._service_of(meta),
@@ -217,6 +241,12 @@ class LiveClusterBackend:
                 not_ready_seconds=not_ready_s,
                 readiness_probe_failing=probe_failing,
                 started_at=parse_iso(status["startTime"]) if status.get("startTime") else None,
+                conditions=[{"type": c.get("type"), "status": c.get("status"),
+                             "reason": c.get("reason")}
+                            for c in status.get("conditions") or []],
+                container_statuses=statuses,
+                resources=resources,
+                labels=dict(meta.get("labels") or {}),
             ))
         return sorted(out, key=lambda p: p.name)
 

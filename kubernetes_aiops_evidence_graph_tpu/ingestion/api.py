@@ -304,6 +304,114 @@ class ApiHandler(BaseHTTPRequestHandler):
         from ..observability import TRACER
         self._json(200, {"spans": TRACER.export(self.query.get("trace_id"))})
 
+    # -- workflow inspection (the Temporal-UI analog; reference
+    # docker-compose.yml:80-92 ships Temporal UI so a human can watch an
+    # incident's steps — here the journal IS the history, VERDICT r4 item 8)
+
+    @route("GET", "/api/v1/workflows")
+    def list_workflows(self):
+        self._json(200, {"workflows": self.app.db.journal_workflows()})
+
+    @route("GET", r"/api/v1/workflows/(?P<workflow_id>[A-Za-z0-9_.:-]+)")
+    def workflow_timeline(self, workflow_id: str):
+        from ..workflow.incident_workflow import STEP_NAMES
+        journal = self.app.db.journal_get(workflow_id)
+        if not journal:
+            return self._json(404, {"error": f"no journal for {workflow_id}"})
+        order = [s for s in STEP_NAMES if s in journal] + \
+                [s for s in journal if s not in STEP_NAMES]
+        steps = [{"step": s, **journal[s]} for s in order]
+        failed = [s["step"] for s in steps if s["status"] == "failed"]
+        running = [s["step"] for s in steps if s["status"] == "running"]
+        done = [s["step"] for s in steps if s["status"] == "completed"]
+        self._json(200, {
+            "workflow_id": workflow_id,
+            "state": ("failed" if failed else "running" if running
+                      else "completed" if done else "pending"),
+            "total_duration_s": sum(s["duration_s"] or 0.0 for s in steps),
+            "steps": steps,
+        })
+
+    @route("GET", "/workflows")
+    def workflows_page(self):
+        self._text(200, _WORKFLOWS_HTML, "text/html; charset=utf-8")
+
+
+# One static self-contained page over the two JSON endpoints above: list on
+# the left, per-step timeline (status, attempts, duration bar) on the right.
+_WORKFLOWS_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>Workflows</title>
+<style>
+ body{font:14px/1.45 system-ui,sans-serif;margin:0;display:flex;height:100vh}
+ #list{width:340px;overflow:auto;border-right:1px solid #ddd;padding:12px}
+ #detail{flex:1;overflow:auto;padding:16px 24px}
+ h1{font-size:16px;margin:0 0 10px}
+ .wf{padding:8px 10px;border-radius:6px;cursor:pointer;margin-bottom:4px}
+ .wf:hover{background:#f2f4f7}.wf.sel{background:#e8eefb}
+ .wf .id{font-family:ui-monospace,monospace;font-size:12px;word-break:break-all}
+ .badge{display:inline-block;padding:1px 8px;border-radius:10px;font-size:11px;
+        color:#fff;margin-left:6px;vertical-align:middle}
+ .completed{background:#2e7d32}.failed{background:#c62828}
+ .running{background:#1565c0}.pending{background:#757575}
+ .skipped{background:#9e9e9e}
+ table{border-collapse:collapse;width:100%;margin-top:10px}
+ td,th{text-align:left;padding:6px 10px;border-bottom:1px solid #eee;
+       vertical-align:top}
+ .bar{height:8px;background:#1565c0;border-radius:4px;min-width:2px}
+ .dur{font-variant-numeric:tabular-nums;white-space:nowrap}
+ pre{background:#f6f8fa;padding:8px;border-radius:6px;max-height:160px;
+     overflow:auto;font-size:11px;margin:4px 0 0}
+ .muted{color:#888}
+</style></head><body>
+<div id="list"><h1>Workflows</h1><div id="rows" class="muted">loading…</div></div>
+<div id="detail"><h1 id="dt">Select a workflow</h1><div id="steps"></div></div>
+<script>
+const esc = s => String(s).replace(/[&<>"]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c]));
+let selected = null;
+async function refreshList(){
+  const r = await fetch('/api/v1/workflows'); const d = await r.json();
+  const rows = document.getElementById('rows'); rows.innerHTML = '';
+  if(!d.workflows.length){rows.textContent = 'no workflows yet'; return;}
+  for(const w of d.workflows){
+    const el = document.createElement('div');
+    el.className = 'wf' + (w.workflow_id === selected ? ' sel' : '');
+    el.innerHTML = `<span class="id">${esc(w.workflow_id)}</span>` +
+      `<span class="badge ${esc(w.state)}">${esc(w.state)}</span>` +
+      `<div class="muted">${w.completed}/${w.steps} steps · ` +
+      `${(w.total_duration_s||0).toFixed(2)}s · ${esc(w.last_update)}</div>`;
+    el.onclick = () => { selected = w.workflow_id; show(w.workflow_id);
+                         refreshList(); };
+    rows.appendChild(el);
+  }
+}
+async function show(id){
+  const r = await fetch('/api/v1/workflows/' + encodeURIComponent(id));
+  const d = await r.json();
+  document.getElementById('dt').innerHTML = `${esc(id)}` +
+    ` <span class="badge ${esc(d.state)}">${esc(d.state)}</span>` +
+    ` <span class="muted dur">${d.total_duration_s.toFixed(2)}s total</span>`;
+  const max = Math.max(...d.steps.map(s => s.duration_s || 0), 1e-9);
+  let html = '<table><tr><th>step</th><th>status</th><th>attempts</th>' +
+             '<th style="width:40%">duration</th><th>updated</th></tr>';
+  for(const s of d.steps){
+    const w = Math.round(100 * (s.duration_s || 0) / max);
+    html += `<tr><td>${esc(s.step)}</td>` +
+      `<td><span class="badge ${esc(s.status)}">${esc(s.status)}</span></td>` +
+      `<td>${s.attempts}</td>` +
+      `<td><div class="bar" style="width:${w}%"></div>` +
+      `<span class="muted dur">${s.duration_s == null ? '—'
+        : s.duration_s.toFixed(3) + 's'}</span>` +
+      (s.result ? `<pre>${esc(JSON.stringify(s.result, null, 1))}</pre>` : '') +
+      `</td><td class="muted dur">${esc(s.updated_at || '')}</td></tr>`;
+  }
+  document.getElementById('steps').innerHTML = html + '</table>';
+}
+refreshList(); setInterval(() => { refreshList();
+  if(selected) show(selected); }, 3000);
+</script></body></html>
+"""
+
 
 def make_server(app, host: str = "127.0.0.1", port: int = 0) -> ThreadingHTTPServer:
     handler = type("BoundApiHandler", (ApiHandler,), {"app": app})

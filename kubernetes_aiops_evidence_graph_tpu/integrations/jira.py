@@ -33,6 +33,7 @@ class JiraClient:
         self,
         incident: Incident,
         top_hypothesis: Optional[Hypothesis] = None,
+        evidence: tuple | list = (),
     ) -> dict:
         description = [f"Incident: {incident.title}",
                        f"Severity: {incident.severity.value}",
@@ -47,6 +48,10 @@ class JiraClient:
                 "Recommended actions:",
                 *[f"- {a}" for a in top_hypothesis.recommended_actions],
             ]
+        from ..runbook.generator import evidence_detail_lines
+        detail = evidence_detail_lines(evidence)
+        if detail:
+            description += ["", "Key evidence:", *[f"- {d}" for d in detail]]
         payload = {
             "fields": {
                 "project": {"key": self.settings.jira_project},

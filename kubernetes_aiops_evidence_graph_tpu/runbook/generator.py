@@ -6,7 +6,51 @@ dashboard deep links, category-specific step additions, persisted runbook.
 """
 from __future__ import annotations
 
+from typing import Sequence
+
 from ..models import Hypothesis, Incident, Runbook, RunbookStep
+
+
+def evidence_detail_lines(evidence: Sequence[dict],
+                          limit: int = 8) -> list[str]:
+    """Human-review lines from anomalous pod evidence payloads — the
+    per-container state / last-state / resource detail the reference
+    records for operators (kubernetes_collector.py:203-267), surfaced in
+    runbooks and tickets (VERDICT r4 item 7). Takes evidence DICTS (the
+    workflow's journal-safe form; Evidence models dump to the same)."""
+    lines: list[str] = []
+    for ev in evidence:
+        if ev.get("evidence_type") not in ("kubernetes_pod", "k8s_pod"):
+            continue
+        if not ev.get("is_anomaly"):
+            continue
+        data = ev.get("data") or {}
+        for cs in data.get("container_statuses") or []:
+            state = ""
+            w = cs.get("waiting")
+            if w and w.get("reason"):
+                state = f" waiting={w['reason']}"
+                if w.get("message"):
+                    state += f" ({w['message']})"
+            t = cs.get("terminated")
+            if t and t.get("reason"):
+                state += f" terminated={t['reason']} exit={t.get('exit_code')}"
+            lt = cs.get("last_terminated")
+            if lt and lt.get("reason"):
+                state += (f" last-terminated={lt['reason']}"
+                          f" exit={lt.get('exit_code')}")
+            res = (data.get("resources") or {}).get(cs.get("name", ""), {})
+            limits = res.get("limits")
+            if limits:
+                state += " limits=" + ",".join(
+                    f"{k}={v}" for k, v in sorted(limits.items()))
+            lines.append(
+                f"pod {ev.get('entity_name', '?')}/{cs.get('name', 'app')}: "
+                f"restarts={cs.get('restart_count', 0)}"
+                f" ready={cs.get('ready')}" + state)
+            if len(lines) >= limit:
+                return lines
+    return lines
 
 _ACTION_COMMANDS: dict[str, list[str]] = {
     "rollback_deployment": [
@@ -71,7 +115,8 @@ class RunbookGenerator:
     def __init__(self, grafana_url: str = "http://localhost:3000") -> None:
         self.grafana_url = grafana_url
 
-    def generate(self, incident: Incident, hypothesis: Hypothesis) -> Runbook:
+    def generate(self, incident: Incident, hypothesis: Hypothesis,
+                 evidence: Sequence[dict] = ()) -> Runbook:
         ctx = {"service": incident.service or "<service>",
                "namespace": incident.namespace}
         kubectl: list[str] = []
@@ -94,6 +139,12 @@ class RunbookGenerator:
         extra = _CATEGORY_STEPS.get(hypothesis.category.value, [])
         for i, desc in enumerate(extra):
             steps.append(RunbookStep(order=3 + i, title="Category check", description=desc))
+        detail = evidence_detail_lines(evidence)
+        if detail:
+            steps.append(RunbookStep(
+                order=len(steps) + 1, title="Key evidence",
+                description="Anomalous container state at collection time:\n"
+                            + "\n".join(detail)))
         steps.append(RunbookStep(
             order=len(steps) + 1, title="Remediate",
             description="Execute the recommended action once confirmed",

@@ -31,6 +31,14 @@ class PodState:
     not_ready_seconds: float = 0.0
     readiness_probe_failing: bool = False
     started_at: Optional[datetime] = None
+    # review-surface detail (reference kubernetes_collector.py:194-267):
+    # populated from the wire by the live backend; None on the fake
+    # cluster, where the collector synthesizes a one-container view from
+    # the scalars above (pod_detail in collectors/kubernetes.py)
+    conditions: Optional[list] = None          # [{type, status, reason}]
+    container_statuses: Optional[list] = None  # per-container state detail
+    resources: Optional[dict] = None           # {container: {requests, limits}}
+    labels: Optional[dict] = None
 
 
 @dataclass

@@ -154,6 +154,7 @@ CREATE TABLE IF NOT EXISTS workflow_journal (
     status TEXT NOT NULL,
     result TEXT,
     attempts INTEGER NOT NULL DEFAULT 0,
+    duration_s REAL,
     updated_at TEXT NOT NULL DEFAULT (strftime('%Y-%m-%dT%H:%M:%fZ','now')),
     PRIMARY KEY (workflow_id, step)
 );
@@ -201,6 +202,13 @@ class Database:
         self._anchor = self._connect()
         with self._lock:
             self._anchor.executescript(_SCHEMA)
+            # migration: pre-round-5 DBs lack duration_s (CREATE TABLE IF
+            # NOT EXISTS never alters an existing table)
+            try:
+                self._anchor.execute(
+                    "ALTER TABLE workflow_journal ADD COLUMN duration_s REAL")
+            except sqlite3.OperationalError:
+                pass  # column already present
             self._anchor.commit()
 
     def _connect(self) -> sqlite3.Connection:
@@ -451,21 +459,59 @@ class Database:
         return {
             r["step"]: {"status": r["status"],
                         "result": json.loads(r["result"]) if r["result"] else None,
-                        "attempts": r["attempts"]}
+                        "attempts": r["attempts"],
+                        "duration_s": r["duration_s"],
+                        "updated_at": r["updated_at"]}
             for r in self.query(
                 "SELECT * FROM workflow_journal WHERE workflow_id=?", (workflow_id,))
         }
 
     def journal_put(self, workflow_id: str, step: str, status: str,
-                    result: Any = None, attempts: int = 0) -> None:
+                    result: Any = None, attempts: int = 0,
+                    duration_s: float | None = None) -> None:
         self.execute(
-            "INSERT INTO workflow_journal (workflow_id, step, status, result, attempts)"
-            " VALUES (?,?,?,?,?)"
+            "INSERT INTO workflow_journal (workflow_id, step, status, result,"
+            " attempts, duration_s)"
+            " VALUES (?,?,?,?,?,?)"
             " ON CONFLICT(workflow_id, step) DO UPDATE SET status=excluded.status,"
             " result=excluded.result, attempts=excluded.attempts,"
+            " duration_s=COALESCE(excluded.duration_s, duration_s),"
             " updated_at=strftime('%Y-%m-%dT%H:%M:%fZ','now')",
             (workflow_id, step, status,
-             json.dumps(result, default=str) if result is not None else None, attempts))
+             json.dumps(result, default=str) if result is not None else None,
+             attempts, duration_s))
+
+    @staticmethod
+    def rollup_state(failed: int, running: int, completed: int) -> str:
+        """Single encoding of the workflow state precedence (failed >
+        running > completed > pending) — shared by the listing SQL rollup,
+        the API timeline, and engine.status (code-review r5)."""
+        return ("failed" if failed else "running" if running
+                else "completed" if completed else "pending")
+
+    def journal_workflows(self, limit: int = 200) -> list[dict]:
+        """Workflow listing for the inspection surface (the Temporal-UI
+        analog, VERDICT r4 item 8): one row per workflow with step-status
+        rollup, ordered most-recently-active first."""
+        rows = self.query(
+            "SELECT workflow_id,"
+            " COUNT(*) AS steps,"
+            " SUM(status='completed') AS completed,"
+            " SUM(status='failed') AS failed,"
+            " SUM(status='running') AS running,"
+            " SUM(status='skipped') AS skipped,"
+            " SUM(COALESCE(duration_s, 0)) AS total_duration_s,"
+            " MIN(updated_at) AS first_update,"
+            " MAX(updated_at) AS last_update"
+            " FROM workflow_journal GROUP BY workflow_id"
+            " ORDER BY last_update DESC LIMIT ?", (limit,))
+        out = []
+        for r in rows:
+            d = dict(r)
+            d["state"] = self.rollup_state(d["failed"], d["running"],
+                                           d["completed"])
+            out.append(d)
+        return out
 
     def close(self) -> None:
         with self._lock:

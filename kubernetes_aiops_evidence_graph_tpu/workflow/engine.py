@@ -115,22 +115,23 @@ class WorkflowEngine:
                                 None, step.fn, ctx),
                             timeout=step.timeout_s)
                 json.dumps(result, default=str)  # journal-serializable check
-                WORKFLOW_STEP_DURATION.observe(
-                    time.perf_counter() - t0, step=step.name)
+                dt = time.perf_counter() - t0
+                WORKFLOW_STEP_DURATION.observe(dt, step=step.name)
                 WORKFLOW_STEPS.inc(step=step.name, status="completed")
                 self.db.journal_put(workflow_id, step.name, "completed",
-                                    result, attempts=attempts)
+                                    result, attempts=attempts, duration_s=dt)
                 return result
             except Exception as exc:
-                WORKFLOW_STEP_DURATION.observe(
-                    time.perf_counter() - t0, step=step.name)
+                dt = time.perf_counter() - t0
+                WORKFLOW_STEP_DURATION.observe(dt, step=step.name)
                 WORKFLOW_STEPS.inc(step=step.name, status="failed")
                 retryable = not isinstance(exc, step.retry.non_retryable)
                 log.warning("step_failed", workflow=workflow_id, step=step.name,
                             attempt=attempts, error=str(exc), retryable=retryable)
                 if not retryable or attempts >= step.retry.max_attempts:
                     self.db.journal_put(workflow_id, step.name, "failed",
-                                        {"error": str(exc)}, attempts=attempts)
+                                        {"error": str(exc)}, attempts=attempts,
+                                        duration_s=dt)
                     raise StepFailed(step.name, exc, attempts) from exc
                 await self._sleep(step.retry.delay(attempts))
 
@@ -146,7 +147,6 @@ class WorkflowEngine:
             "completed": done,
             "failed": failed,
             "running": running,
-            "state": ("failed" if failed else
-                      "running" if running else
-                      "completed" if done else "pending"),
+            "state": self.db.rollup_state(
+                len(failed), len(running), len(done)),
         }

@@ -232,7 +232,8 @@ def rank_hypotheses(ctx: IncidentContext) -> dict:
 def generate_runbook(ctx: IncidentContext) -> dict:
     if not _ensure_hypotheses(ctx):
         return {"generated": False}
-    rb = RunbookGenerator().generate(ctx.incident, ctx.hypotheses[0])
+    rb = RunbookGenerator().generate(ctx.incident, ctx.hypotheses[0],
+                                     evidence=ctx.evidence_dicts)
     ctx.db.insert_runbook(rb)
     return {"generated": True, "title": rb.title, "steps": len(rb.steps)}
 
@@ -342,7 +343,8 @@ async def verify_remediation(ctx: IncidentContext) -> dict:
 def create_ticket(ctx: IncidentContext) -> dict:
     jira = ctx.jira or JiraClient(ctx.settings)
     hyps = _ensure_hypotheses(ctx)
-    return jira.create_incident_ticket(ctx.incident, hyps[0] if hyps else None)
+    return jira.create_incident_ticket(ctx.incident, hyps[0] if hyps else None,
+                                       evidence=ctx.evidence_dicts)
 
 
 def close_incident(ctx: IncidentContext) -> dict:
@@ -374,6 +376,16 @@ def _needs_ticket(ctx: IncidentContext) -> bool:
     return (not policy.get("allowed", False)
             or not (ctx.results.get("request_approval") or {}).get("approved", False)
             or verify.get("success") is False)  # incident_workflow.py:246-250
+
+
+# canonical step order for inspection surfaces (the 12-step lifecycle);
+# kept in sync with incident_steps() below
+STEP_NAMES = (
+    "collect_evidence", "build_graph", "generate_hypotheses",
+    "rank_hypotheses", "generate_runbook", "calculate_blast_radius",
+    "evaluate_policy", "request_approval", "execute_remediation",
+    "verify_remediation", "create_ticket", "close_incident",
+)
 
 
 def incident_steps(settings: Settings | None = None) -> list[Step]:

@@ -1,0 +1,96 @@
+"""Evidence payload depth for the review surface (VERDICT r4 item 7).
+
+The reference records per-container conditions, waiting/terminated/
+last-terminated detail, restart counts and resource requests/limits into
+pod evidence payloads for human review (kubernetes_collector.py:194-267).
+These tests pin that payload shape on the FAKE-cluster path (synthesized
+one-container view — the live path is proven wire-level in
+test_live_fixtures.py::test_pod_review_payload_parity_with_reference) and
+that runbooks and Jira tickets actually surface it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.collectors import (
+    collect_all, default_collectors)
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.integrations.jira import JiraClient
+from kubernetes_aiops_evidence_graph_tpu.models import EvidenceType
+from kubernetes_aiops_evidence_graph_tpu.runbook import RunbookGenerator
+from kubernetes_aiops_evidence_graph_tpu.runbook.generator import (
+    evidence_detail_lines)
+from kubernetes_aiops_evidence_graph_tpu.simulator import (
+    generate_cluster, inject)
+
+# reference pod payload keys (kubernetes_collector.py:150-163)
+REFERENCE_POD_KEYS = {
+    "phase", "restart_count", "waiting_reason", "terminated_reason",
+    "conditions", "container_statuses", "resources", "labels", "created_at",
+}
+
+
+def _crashloop_world():
+    settings = load_settings()
+    cluster = generate_cluster(num_pods=96, seed=11)
+    rng = np.random.default_rng(11)
+    target = sorted(cluster.deployments)[0]
+    inc = inject(cluster, "crashloop_deploy", target, rng)
+    results = collect_all(inc, default_collectors(cluster, settings),
+                          parallel=False)
+    evidence = [e for r in results for e in r.evidence]
+    return inc, evidence
+
+
+def test_fake_pod_evidence_carries_reference_payload_shape():
+    inc, evidence = _crashloop_world()
+    pods = [e for e in evidence
+            if e.evidence_type == EvidenceType.KUBERNETES_POD]
+    assert pods, "no pod evidence collected"
+    crash = next(e for e in pods
+                 if e.data.get("waiting_reason") == "CrashLoopBackOff")
+    assert REFERENCE_POD_KEYS <= set(crash.data)
+
+    (cs,) = crash.data["container_statuses"]
+    assert cs["waiting"]["reason"] == "CrashLoopBackOff"
+    assert cs["restart_count"] == crash.data["restart_count"]
+    conds = crash.data["conditions"]
+    assert any(c["type"] == "Ready" for c in conds)
+
+
+def test_fake_oom_pod_reports_last_terminated_exit_137():
+    settings = load_settings()
+    cluster = generate_cluster(num_pods=96, seed=12)
+    rng = np.random.default_rng(12)
+    inc = inject(cluster, "oom", sorted(cluster.deployments)[1], rng)
+    results = collect_all(inc, default_collectors(cluster, settings),
+                          parallel=False)
+    oom = next(e for r in results for e in r.evidence
+               if e.evidence_type == EvidenceType.KUBERNETES_POD
+               and e.data.get("terminated_reason") == "OOMKilled")
+    (cs,) = oom.data["container_statuses"]
+    assert cs["last_terminated"] == {"reason": "OOMKilled", "exit_code": 137}
+
+
+def test_evidence_detail_lines_render_container_state():
+    _, evidence = _crashloop_world()
+    lines = evidence_detail_lines([e.model_dump(mode="json")
+                                   for e in evidence])
+    assert lines, "no detail lines from anomalous pod evidence"
+    assert any("waiting=CrashLoopBackOff" in ln for ln in lines)
+    assert all(ln.startswith("pod ") for ln in lines)
+
+
+def test_runbook_and_ticket_surface_evidence_detail():
+    from kubernetes_aiops_evidence_graph_tpu.rca import get_backend
+    inc, evidence = _crashloop_world()
+    ev_dicts = [e.model_dump(mode="json") for e in evidence]
+    hyp = get_backend("cpu").score_incident(inc.id, ev_dicts).top_hypothesis
+    rb = RunbookGenerator().generate(inc, hyp, evidence=ev_dicts)
+    key_steps = [s for s in rb.steps if s.title == "Key evidence"]
+    assert key_steps and "waiting=CrashLoopBackOff" in key_steps[0].description
+
+    jira = JiraClient(load_settings())          # unconfigured -> outbox
+    out = jira.create_incident_ticket(inc, hyp, evidence=ev_dicts)
+    desc = out["payload"]["fields"]["description"]
+    assert "Key evidence:" in desc and "waiting=CrashLoopBackOff" in desc

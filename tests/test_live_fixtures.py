@@ -249,3 +249,41 @@ def test_prometheus_envelope_and_params(strict):
     assert req["params"]["start"] == "1753790000"
     assert req["params"]["end"] == "1753790400"
     assert 'namespace="shop"' in req["params"]["query"]
+
+
+def test_pod_review_payload_parity_with_reference(strict):
+    """The parsed PodState must carry the reference's review-surface
+    payload (kubernetes_collector.py:194-267): per-pod conditions, per-
+    container statuses with waiting/terminated/last-terminated detail,
+    resource requests/limits, and labels — straight from the wire, not
+    synthesized. The waiting pod in the fixture (…00007: CrashLoopBackOff
+    with a lastState.terminated) is the probe."""
+    base, _ = strict
+    pods = {p.name: p for p in _backend(base).list_pods("shop")}
+    crash = next(p for n, p in pods.items() if n.endswith("00007"))
+
+    # reference payload shape: top-level conditions [{type,status,reason}]
+    assert {c["type"] for c in crash.conditions} >= {"Ready", "PodScheduled"}
+    assert all(set(c) == {"type", "status", "reason"}
+               for c in crash.conditions)
+
+    # per-container detail incl. waiting message and last-terminated exit
+    (cs,) = crash.container_statuses
+    assert set(cs) >= {"name", "ready", "restart_count", "waiting",
+                       "last_terminated"}
+    assert cs["waiting"]["reason"] == "CrashLoopBackOff"
+    assert cs["waiting"]["message"]            # the human-review string
+    assert cs["last_terminated"]["exit_code"] is not None
+
+    # resource requests/limits from the pod spec
+    res = crash.resources[cs["name"]]
+    assert res["requests"]["memory"] == "256Mi"
+    assert res["limits"]["memory"] == "512Mi"
+
+    # labels for entity browsing
+    assert crash.labels.get("app") == "checkout"
+
+    # a healthy pod parses too (running state, no waiting block)
+    healthy = next(p for n, p in pods.items() if n.endswith("00000"))
+    (hs,) = healthy.container_statuses
+    assert hs["ready"] is True and "waiting" not in hs
