@@ -78,25 +78,26 @@ def fold_accounting(pi: int, width: int, pair_width: int, dim: int,
 
 def gnn_layer_accounting(pn: int, e: int, hidden: int) -> dict:
     """Minimum HBM bytes + FLOPs of one `gnn._message_pass` layer
-    (relation-aware: R = gnn.NUM_RELS per-relation transforms).
+    (relation-aware, transform-then-gather formulation: all R = NUM_RELS
+    transformed copies are computed densely, each edge gathers its
+    rel-specific source row, aggregation is one [E, H] segment-sum).
 
-    reads  — message gather h[edge_src] E*H, edge mask + rel 2E, inv_deg
-             Pn, h twice (w_self matmul + residual) 2*Pn*H, per-relation
-             agg for the einsum Pn*R*H, weights H*H + R*H*H + H;
-    writes — per-(node, relation) accumulator Pn*R*H (plus E*H
-             read-modify-write traffic for the scatter-add, counted once
-             as E*H), mixed + layer output 2*Pn*H.
-    FLOPs — mask multiply E*H, scatter adds E*H, degree scale Pn*R*H,
-            w_self matmul 2*Pn*H*H, relation einsum 2*Pn*R*H*H,
+    reads  — h for the two matmuls + residual 3*Pn*H, weights
+             R*H*H + H*H + H, transformed-copy gather E*H (from the
+             [Pn*R, H] table), edge mask + rel 2E, inv_deg Pn;
+    writes — transformed copies Pn*R*H, scatter accumulator Pn*H (plus
+             E*H read-modify-write traffic, counted once as E*H), layer
+             output Pn*H.
+    FLOPs — relation einsum 2*Pn*R*H*H, w_self matmul 2*Pn*H*H, mask
+            multiply E*H, scatter adds E*H, degree scale Pn*H,
             bias+relu+residual 3*Pn*H.
     """
     from .gnn import NUM_RELS as r
-    reads = (e * hidden + 2 * e + pn + 2 * pn * hidden + pn * r * hidden
-             + hidden * hidden + r * hidden * hidden + hidden) * 4
+    reads = (3 * pn * hidden + r * hidden * hidden + hidden * hidden
+             + hidden + e * hidden + 2 * e + pn) * 4
     writes = (pn * r * hidden + 2 * pn * hidden + e * hidden) * 4
-    flops = (2 * e * hidden + pn * r * hidden
-             + 2 * pn * hidden * hidden + 2 * pn * r * hidden * hidden
-             + 3 * pn * hidden)
+    flops = (2 * pn * r * hidden * hidden + 2 * pn * hidden * hidden
+             + 2 * e * hidden + pn * hidden + 3 * pn * hidden)
     return {"bytes": reads + writes, "flops": flops,
             "reads": reads, "writes": writes}
 

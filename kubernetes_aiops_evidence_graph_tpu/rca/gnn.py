@@ -10,8 +10,11 @@ an incident node's OWN evidence arrives over AFFECTS edges while the
 deployment/service commons arrive over OWNS/SELECTS/SCHEDULED_ON paths —
 a relation-blind mean blends them, and measurably confuses incident pairs
 sharing a deployment (round-4 holdout: every miss predicted its
-deployment-mate's rule). The per-relation aggregation is one [N, R, H]
-scatter + one nrh,rhk einsum — dense MXU work, no sparse ops.
+deployment-mate's rule). The per-relation math is mapped as
+transform-then-gather: R stacked MXU matmuls produce every relation's
+transformed copy, each edge gathers its rel-specific source row, and
+aggregation stays one [E, H] segment-sum (see _message_pass for the
+measured 9.4x penalty of the scatter-bucket alternative).
 
 Complements the deterministic ruleset backend with a trainable one
 (HypothesisSource.GNN); simulator scenarios provide labeled training data.
@@ -59,17 +62,23 @@ def init_params(key: jax.Array, hidden: int = 64, layers: int = 3) -> Params:
 
 
 def _message_pass(h, layer, edge_src, edge_dst, edge_rel, edge_mask, inv_deg):
-    """One relation-aware round: messages segment-sum into per-(node,
-    relation) buckets, then each relation's bucket goes through its own
-    transform (one dense einsum — R stacked matmuls on the MXU). Padded
-    edges carry rel=-1: clipped to 0, but their mask already zeroes the
-    message."""
-    msg = h[edge_src] * edge_mask[:, None]
+    """One relation-aware round, TPU-mapped as transform-THEN-gather: the
+    per-relation transform is linear, so sum_e W_{rel_e} h_src ==
+    sum_r W_r (sum_{e: rel_e=r} h_src). Computing all R transformed
+    copies densely first ([N, R, H] einsum — R stacked matmuls on the
+    MXU) lets each edge GATHER its source's rel-specific row (flattened
+    1-D gather) and keeps the aggregation the ORIGINAL single [E, H]
+    segment-sum. The alternative — scatter into per-(node, relation)
+    buckets with a 2-D index — measured 9.4x slower on v5e-1 (291 ms vs
+    31 ms at the 58k-node config): TPU scatters serialize, matmuls don't.
+    Padded edges carry rel=-1: clipped to 0, but their mask already
+    zeroes the message."""
     rel = jnp.clip(edge_rel, 0, NUM_RELS - 1)
-    agg = jnp.zeros((h.shape[0], NUM_RELS, h.shape[1]), h.dtype
-                    ).at[edge_dst, rel].add(msg) * inv_deg[:, None, None]
-    mixed = jnp.einsum("nrh,rhk->nk", agg, layer["w_rel"])
-    return jax.nn.relu(h @ layer["w_self"] + mixed + layer["b"]) + h
+    hr = jnp.einsum("nh,rhk->nrk", h, layer["w_rel"])        # [N, R, H]
+    flat = hr.reshape(h.shape[0] * NUM_RELS, h.shape[1])
+    msg = flat[edge_src * NUM_RELS + rel] * edge_mask[:, None]
+    agg = jnp.zeros_like(h).at[edge_dst].add(msg) * inv_deg[:, None]
+    return jax.nn.relu(h @ layer["w_self"] + agg + layer["b"]) + h
 
 
 def forward(

@@ -110,7 +110,6 @@ def main() -> int:
 
     t_start = time.time()
     record: dict = {"scenario": args.scenario, "ok": False}
-    record["compose"] = check_compose()
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -130,6 +129,9 @@ def main() -> int:
     port = app.start(host="127.0.0.1")
     base = f"http://127.0.0.1:{port}"
     try:
+        # inside the try so a compose-validation failure still writes the
+        # artifact (the finally below) — the script's stated contract
+        record["compose"] = check_compose()
         # fault injection — the simulator mutates the fake cluster the
         # same way scripts in the reference mutate a kind cluster
         target = sorted(cluster.deployments)[0]

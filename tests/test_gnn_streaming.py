@@ -195,7 +195,7 @@ def test_overflow_remirror_sentinel_tracks_new_pe(params, monkeypatch):
 
     # a tiny ladder makes any 5-pair delta overflow it
     monkeypatch.setattr(gs, "_DELTA_BUCKETS", (4, 8))
-    scorer._pending_edges = {s: (0, 1, 1) for s in (0, 2, 4, 6, 8)}
+    scorer._pending_edges = {s: (0, 1, 0, 1) for s in (0, 2, 4, 6, 8)}
     ints, pk, ek = scorer._packed_gnn_delta([])
     pe_new = int(scorer._esrc_dev.shape[0])
     assert pe_new > pe_old, "re-mirror should have re-bucketed"
