@@ -55,7 +55,7 @@ def _ring_messages(h_local, w_rel, esrc, erel, emask, edst_local, d: int):
     rotates one hop around the ring (ppermute over 'graph')."""
     nps = h_local.shape[0]
     my = jax.lax.axis_index("graph")
-    rel = jnp.clip(erel, 0, gnn.NUM_RELS - 1)
+    rel = erel   # rel_messages clips internally
 
     def body(r, carry):
         h_block, agg = carry
@@ -63,9 +63,8 @@ def _ring_messages(h_local, w_rel, esrc, erel, emask, edst_local, d: int):
         lo = src_shard * nps
         in_block = ((esrc >= lo) & (esrc < lo + nps)).astype(h_block.dtype)
         local_src = jnp.clip(esrc - lo, 0, nps - 1)
-        hr = jnp.einsum("nh,rhk->nrk", h_block, w_rel)
-        flat = hr.reshape(nps * gnn.NUM_RELS, -1)
-        msg = flat[local_src * gnn.NUM_RELS + rel] * (emask * in_block)[:, None]
+        msg = gnn.rel_messages(h_block, w_rel, local_src, rel,
+                               emask * in_block)
         agg = agg.at[edst_local].add(msg)
         h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(d))
         return h_block, agg
@@ -121,7 +120,6 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
             feats @ params["embed_w"] + params["embed_b"] + params["kind_emb"][kind]
         ) * nmask[:, None]
 
-        rel = jnp.clip(erel, 0, gnn.NUM_RELS - 1)
         for layer in params["layers"]:
             # halo exchange: every shard needs src embeddings of its
             # in-edges. Both strategies use the transform-then-gather
@@ -134,9 +132,8 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
                                      emask, edst_local, graph_size)
             else:
                 h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
-                hr = jnp.einsum("nh,rhk->nrk", h_full, layer["w_rel"])
-                flat = hr.reshape(h_full.shape[0] * gnn.NUM_RELS, -1)
-                msg = flat[esrc * gnn.NUM_RELS + rel] * emask[:, None]
+                msg = gnn.rel_messages(h_full, layer["w_rel"], esrc, erel,
+                                       emask)
                 agg = jnp.zeros_like(h_local).at[edst_local].add(msg)
             agg = agg * inv_deg[:, None]
             h_local = jax.nn.relu(

@@ -61,6 +61,21 @@ def init_params(key: jax.Array, hidden: int = 64, layers: int = 3) -> Params:
     return params
 
 
+def rel_messages(h_table, w_rel, src_index, edge_rel, edge_mask):
+    """[E, H] per-edge messages under the transform-then-gather mapping —
+    THE one implementation of the relation-aware kernel (see
+    _message_pass for why the scatter-bucket alternative lost 9.4x):
+    every relation's transformed copy of ``h_table`` is computed densely
+    (stacked MXU matmuls), then each edge gathers its rel-specific source
+    row via the flattened index. Shared by the single-device layer and
+    both sharded halo strategies (parallel/sharded_gnn.py), so the
+    bit-identical-to-single-device invariant rests on one kernel."""
+    rel = jnp.clip(edge_rel, 0, NUM_RELS - 1)
+    hr = jnp.einsum("nh,rhk->nrk", h_table, w_rel)      # [N, R, H]
+    flat = hr.reshape(h_table.shape[0] * NUM_RELS, h_table.shape[1])
+    return flat[src_index * NUM_RELS + rel] * edge_mask[:, None]
+
+
 def _message_pass(h, layer, edge_src, edge_dst, edge_rel, edge_mask, inv_deg):
     """One relation-aware round, TPU-mapped as transform-THEN-gather: the
     per-relation transform is linear, so sum_e W_{rel_e} h_src ==
@@ -73,10 +88,7 @@ def _message_pass(h, layer, edge_src, edge_dst, edge_rel, edge_mask, inv_deg):
     31 ms at the 58k-node config): TPU scatters serialize, matmuls don't.
     Padded edges carry rel=-1: clipped to 0, but their mask already
     zeroes the message."""
-    rel = jnp.clip(edge_rel, 0, NUM_RELS - 1)
-    hr = jnp.einsum("nh,rhk->nrk", h, layer["w_rel"])        # [N, R, H]
-    flat = hr.reshape(h.shape[0] * NUM_RELS, h.shape[1])
-    msg = flat[edge_src * NUM_RELS + rel] * edge_mask[:, None]
+    msg = rel_messages(h, layer["w_rel"], edge_src, edge_rel, edge_mask)
     agg = jnp.zeros_like(h).at[edge_dst].add(msg) * inv_deg[:, None]
     return jax.nn.relu(h @ layer["w_self"] + agg + layer["b"]) + h
 

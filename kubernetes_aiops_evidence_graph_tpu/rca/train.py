@@ -67,7 +67,7 @@ def _touches_protected(cluster, ev, deps: set, svcs: set) -> bool:
 
 
 def make_episode(num_pods: int, num_incidents: int, seed: int,
-                 churn: int = 0, dense: bool = False,
+                 churn: int = 0, dense: bool = False, unknowns: int = 0,
                  return_snapshot: bool = False) -> dict:
     """One labeled training episode: a fresh simulated cluster with
     ``num_incidents`` injected scenarios → snapshot batch + labels.
@@ -83,8 +83,14 @@ def make_episode(num_pods: int, num_incidents: int, seed: int,
     derivable (VERDICT r4 item 4). ``dense=True`` targets adjacent deployments (stride 1 over
     the sorted keys — same-namespace runs) and orders scenarios as the
     confusable pairs above, maximizing evidence interference between
-    incidents. ``return_snapshot=True`` adds the GraphSnapshot under
-    ``"snapshot"`` (oracle cross-checks; batch consumers ignore it)."""
+    incidents. ``unknowns`` additionally opens that many NO-FAULT
+    incidents (alerts over healthy deployments: AFFECTS edges to healthy
+    pods, nothing injected) labeled with the unknown class — without
+    them the model never sees a negative example and confidently
+    diagnoses healthy evidence (measured: 0.86-confidence oom on one
+    healthy pod, where the rules engine abstains).
+    ``return_snapshot=True`` adds the GraphSnapshot under ``"snapshot"``
+    (oracle cross-checks; batch consumers ignore it)."""
     from ..collectors import collect_all, default_collectors
     from ..config import load_settings
     from ..graph import GraphBuilder, build_snapshot
@@ -124,6 +130,32 @@ def make_episode(num_pods: int, num_incidents: int, seed: int,
         builder.ingest(inc, collect_all(inc, default_collectors(cluster, settings),
                                         parallel=False))
         labels.append(RULE_INDEX[SCENARIOS[name].expected_rule])
+    # no-fault incidents may only target deployments NO fault touched —
+    # an index collision would attach genuinely faulty pods to an
+    # "unknown"-labeled incident, poisoning the abstention class
+    # (code-review r5: the arithmetic pick collided for 10% of episodes)
+    faulted = {(i * stride) % len(deploy_keys) for i in range(num_incidents)}
+    clean_idx = [j for j in range(len(deploy_keys)) if j not in faulted]
+    for u in range(min(unknowns, len(clean_idx))):
+        # a "false alarm": incident over a deployment nothing was injected
+        # into — evidence exists (its healthy pods) but supports no rule
+        from ..graph import ids
+        from ..models import GraphEntity, GraphRelation
+        target = deploy_keys[clean_idx[(u * 7 + 3) % len(clean_idx)]]
+        ns, dname = target.split("/", 1)
+        d = cluster.deployments[target]
+        inc_nid = f"incident:unknown-{seed}-{u}"
+        builder.store.upsert_entities([GraphEntity(
+            id=inc_nid, type="Incident",
+            properties={"severity": "low", "service": dname,
+                        "namespace": ns})])
+        pods = cluster.list_pods(ns, d.service)[:4]
+        builder.store.upsert_relations([
+            GraphRelation(source_id=inc_nid,
+                          target_id=ids.pod_id(p.namespace, p.name),
+                          relation_type="AFFECTS")
+            for p in pods])
+        labels.append(gnn.NUM_CLASSES - 1)
     if churn:
         applied = 0
         # oversample: some events are vetoed by protection
@@ -144,7 +176,7 @@ def make_episode(num_pods: int, num_incidents: int, seed: int,
 
 def make_dataset(episodes: int, num_pods: int | Sequence[int] = 96,
                  num_incidents: int = 6, seed: int = 0, churn: int = 0,
-                 dense: bool = False,
+                 dense: bool = False, unknowns: int = 0,
                  return_snapshot: bool = False) -> list[dict]:
     """``num_pods`` may be a sequence of cluster sizes, cycled per episode
     — the product-scale evaluation trains across 96→2k-pod clusters so the
@@ -152,7 +184,7 @@ def make_dataset(episodes: int, num_pods: int | Sequence[int] = 96,
     ``return_snapshot`` pass through to make_episode."""
     sizes = ([num_pods] if isinstance(num_pods, int) else list(num_pods))
     return [make_episode(sizes[e % len(sizes)], num_incidents, seed + e,
-                         churn=churn, dense=dense,
+                         churn=churn, dense=dense, unknowns=unknowns,
                          return_snapshot=return_snapshot)
             for e in range(episodes)]
 
@@ -245,6 +277,11 @@ def train(episodes: int = 8, steps: int = 200,
                         return_snapshot=with_confusion)
     holdout = data[len(data) - eval_holdout:] if eval_holdout else []
     train_set = data[:len(data) - eval_holdout] if eval_holdout else data
+    # the jitted train step takes the batch dict as a pytree: the holdout
+    # keeps its snapshots (crosscheck_holdout needs them) but TRAIN
+    # batches must not carry a non-array
+    train_set = [{k: v for k, v in b.items() if k != "snapshot"}
+                 for b in train_set]
     if augment_dense:
         # disjoint seed block; small clusters = maximal evidence overlap
         train_set = train_set + make_dataset(
@@ -256,9 +293,12 @@ def train(episodes: int = 8, steps: int = 200,
             seed=seed + 70000, churn=40 * max(num_incidents, 1))
     if augment_small:
         # plain small worlds: natural (stride-5) interference at the scale
-        # where every round-4 holdout miss lived (96-pod episode 125)
+        # where every round-4 holdout miss lived (96-pod episode 125);
+        # each also carries two no-fault incidents so the unknown class
+        # has training support
         train_set = train_set + make_dataset(
-            augment_small, [96, 128], num_incidents, seed=seed + 90000)
+            augment_small, [96, 128], num_incidents, seed=seed + 90000,
+            unknowns=2)
 
     params = gnn.init_params(jax.random.PRNGKey(seed), hidden=hidden, layers=layers)
     tx = optax.adamw(lr, weight_decay=weight_decay) if weight_decay \

@@ -279,12 +279,18 @@ def sync_touched_to_store(cluster: FakeCluster, store, touched: list[str]) -> No
             ns, name = rest.split(":", 1)
             p = cluster.pods.get(f"{ns}/{name}")
             if p is not None:
+                from ..collectors.kubernetes import pod_detail
                 node_obj = store._nodes[nid]  # in-place property update
                 node_obj.properties.update(
                     waiting_reason=p.waiting_reason,
                     terminated_reason=p.terminated_reason,
                     restart_count=p.restart_count, ready=p.ready,
-                    not_ready_seconds=p.not_ready_seconds, phase=p.phase)
+                    not_ready_seconds=p.not_ready_seconds, phase=p.phase,
+                    # keep the review-surface detail coherent with the
+                    # scalars: graph-API consumers read node properties,
+                    # and a churned pod must not show pre-churn container
+                    # state next to post-churn scalars
+                    **pod_detail(p))
         elif kind == "service":
             ns, name = rest.split(":", 1)
             m = cluster.metrics.get(f"{ns}/{name}")

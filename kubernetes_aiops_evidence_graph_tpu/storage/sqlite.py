@@ -203,12 +203,17 @@ class Database:
         with self._lock:
             self._anchor.executescript(_SCHEMA)
             # migration: pre-round-5 DBs lack duration_s (CREATE TABLE IF
-            # NOT EXISTS never alters an existing table)
-            try:
-                self._anchor.execute(
-                    "ALTER TABLE workflow_journal ADD COLUMN duration_s REAL")
-            except sqlite3.OperationalError:
-                pass  # column already present
+            # NOT EXISTS never alters an existing table). Probe first —
+            # an unconditional ALTER takes a write lock on EVERY open,
+            # which two contending worker processes can trip over
+            cols = {r[1] for r in self._anchor.execute(
+                "PRAGMA table_info(workflow_journal)")}
+            if "duration_s" not in cols:
+                try:
+                    self._anchor.execute("ALTER TABLE workflow_journal"
+                                         " ADD COLUMN duration_s REAL")
+                except sqlite3.OperationalError:
+                    pass  # a racing migrator added it first
             self._anchor.commit()
 
     def _connect(self) -> sqlite3.Connection:

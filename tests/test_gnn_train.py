@@ -189,3 +189,49 @@ def test_shipped_checkpoint_scores_product_scenarios(monkeypatch):
     results = backend.results(snap)
     got = {str(r.incident_id): r.top_hypothesis.rule_id for r in results}
     assert got == expected
+
+
+def test_shipped_checkpoint_abstains_on_healthy_evidence(monkeypatch):
+    """A false alarm — an incident whose only evidence is a HEALTHY pod,
+    or no evidence at all — must come back as the unknown hypothesis, the
+    same abstention the rules engine produces. Without unknown-class
+    training examples the model confidently diagnosed a fault here
+    (measured: 0.86-confidence oom_high_memory on one healthy pod)."""
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import (
+        GraphBuilder, build_snapshot)
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.models import (
+        GraphEntity, GraphRelation)
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+        GnnRcaBackend)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster
+
+    monkeypatch.delenv("KAEG_GNN_CHECKPOINT", raising=False)
+    settings = load_settings(
+        node_bucket_sizes=(512,), edge_bucket_sizes=(2048,),
+        incident_bucket_sizes=(8,))
+    cluster = generate_cluster(num_pods=96, seed=4)
+    b = GraphBuilder()
+    sync_topology(cluster, b.store)
+    pod = sorted(n for n in b.store._nodes if n.startswith("pod:"))[0]
+    b.store.upsert_entities([
+        GraphEntity(id="incident:empty", type="Incident",
+                    properties={"severity": "high"}),
+        GraphEntity(id="incident:healthy", type="Incident",
+                    properties={"severity": "low"}),
+    ])
+    b.store.upsert_relations([GraphRelation(
+        source_id="incident:healthy", target_id=pod,
+        relation_type="AFFECTS")])
+    snap = build_snapshot(b.store, settings)
+
+    backend = GnnRcaBackend()
+    raw = backend.score_snapshot(snap)
+    for i, iid in enumerate(raw["incident_ids"]):
+        assert not raw["any_match"][i], (
+            f"{iid}: GNN diagnosed a fault from healthy/absent evidence "
+            f"(top_rule_index={raw['top_rule_index'][i]})")
+    for res in backend.results(snap, raw=raw):
+        assert res.top_hypothesis.rule_id == "unknown"
