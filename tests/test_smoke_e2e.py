@@ -1,0 +1,39 @@
+"""Bind scripts/smoke_e2e.py to the test suite.
+
+CI runs the smoke as its own step, but `pytest tests/` alone should catch
+a broken demo flow too — the script is the product's one-command
+webhook→resolved proof (VERDICT r4 item 5), so it must never rot.
+Subprocess invocation: the script owns its platform setup (forces the
+virtual-CPU backend before importing jax), which must not leak into or
+inherit from the test process's JAX state.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_smoke_script_end_to_end(tmp_path):
+    out = tmp_path / "smoke.json"
+    # pin the documented 1-device CLI configuration: pytest's conftest
+    # exports an 8-device XLA_FLAGS which the script's setdefault would
+    # otherwise inherit, silently validating a different device config
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "smoke_e2e.py"),
+         "--out", str(out)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, f"smoke failed:\n{r.stdout[-800:]}\n{r.stderr[-800:]}"
+    record = json.loads(r.stdout.strip().splitlines()[-1])
+    assert record["ok"] is True
+    assert record["incident_status"] == "resolved"
+    assert record["top_rule"] == "crashloop_recent_deploy"
+    assert record["incidents_resolved_total"] >= 1
+    # the artifact contract: written where pointed, parseable
+    assert json.load(open(out))["ok"] is True
