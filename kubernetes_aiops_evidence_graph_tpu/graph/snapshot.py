@@ -210,11 +210,20 @@ def build_snapshot(
     m = len(raw_edges)
     pe = bucket_for(max(m, 1), cfg.edge_bucket_sizes)
     edge_src = np.zeros(pe, dtype=np.int32)
-    edge_dst = np.zeros(pe, dtype=np.int32)
+    # padding dst = LAST node row, not 0: keeps the whole dst array
+    # monotone after the live-prefix sort below (their mask-zeroed
+    # messages add 0.0 to that row either way)
+    edge_dst = np.full(pe, pn - 1, dtype=np.int32)
     edge_rel = np.full(pe, -1, dtype=np.int32)
     edge_mask = np.zeros(pe, dtype=np.float32)
     if m:
         arr = np.asarray(raw_edges, dtype=np.int32)
+        # live edges sorted by destination: COO consumers are
+        # order-insensitive, and dst-sorted indices let the GNN's
+        # segment-sum take the indices_are_sorted fast path (measured
+        # 1.9x on the v5e scatter; gnn.forward sorted_by_dst)
+        order = np.argsort(arr[:, 1], kind="stable")
+        arr = arr[order]
         edge_src[:m], edge_dst[:m], edge_rel[:m] = arr[:, 0], arr[:, 1], arr[:, 2]
         edge_mask[:m] = 1.0
 

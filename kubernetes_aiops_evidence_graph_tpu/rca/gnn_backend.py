@@ -9,6 +9,7 @@ or are injected directly.
 """
 from __future__ import annotations
 
+from functools import partial
 from uuid import uuid4
 
 import numpy as np
@@ -63,12 +64,19 @@ class GnnRcaBackend:
                     "rca/train.py or point KAEG_GNN_CHECKPOINT at a current "
                     "checkpoint")
         self.params = params
-        self._forward = jax.jit(gnn.forward)
+        # build_snapshot emits dst-sorted edges -> sorted segment-sum
+        # fast path; gnn.edges_sorted_by_dst guards the promise per
+        # snapshot (checked once per scoring call — O(E) host scan,
+        # noise next to tensorization)
+        self._forward = jax.jit(partial(gnn.forward, sorted_by_dst=True))
+        self._forward_unsorted = jax.jit(gnn.forward)
 
     def score_snapshot(self, snapshot) -> dict:
         """Same keys as TpuRcaBackend.score_snapshot where meaningful."""
         b = gnn.snapshot_batch(snapshot)
-        logits = self._forward(
+        fwd = self._forward if gnn.edges_sorted_by_dst(b["edge_dst"]) \
+            else self._forward_unsorted
+        logits = fwd(
             self.params, b["features"], b["node_kind"], b["node_mask"],
             b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
             b["incident_nodes"])

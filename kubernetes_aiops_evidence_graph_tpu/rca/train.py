@@ -192,10 +192,14 @@ def make_dataset(episodes: int, num_pods: int | Sequence[int] = 96,
 def _predictions(params: gnn.Params, batches: Sequence[dict]
                  ) -> tuple[np.ndarray, np.ndarray]:
     """(labels, predictions) over the labeled incidents of ``batches``."""
-    fwd = jax.jit(gnn.forward)   # one wrapper: compile at most once per shape
+    from functools import partial
+    # snapshot batches are dst-sorted (build_snapshot) -> fast segment-sums
+    fwd = jax.jit(partial(gnn.forward, sorted_by_dst=True))
+    fwd_unsorted = jax.jit(gnn.forward)
     y_true, y_pred = [], []
     for b in batches:
-        logits = fwd(
+        logits = (fwd if gnn.edges_sorted_by_dst(b["edge_dst"])
+                  else fwd_unsorted)(
             params, b["features"], b["node_kind"], b["node_mask"],
             b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
             b["incident_nodes"])
@@ -362,9 +366,11 @@ def crosscheck_holdout(params: gnn.Params,
     from . import get_backend
     from .ruleset import RULES
 
+    from functools import partial
     rule_ids = [r.id for r in RULES]
     backend = get_backend("tpu")
-    fwd = jax.jit(gnn.forward)
+    fwd = jax.jit(partial(gnn.forward, sorted_by_dst=True))
+    fwd_unsorted = jax.jit(gnn.forward)
     misses, total, correct, ambiguous = [], 0, 0, 0
     clean_total = clean_correct = 0
     twin_pairs = 0
@@ -373,10 +379,12 @@ def crosscheck_holdout(params: gnn.Params,
             raise ValueError(
                 "crosscheck_holdout needs batches built with "
                 "return_snapshot=True (the oracle scores the snapshot)")
-        logits = np.asarray(fwd(
-            params, b["features"], b["node_kind"], b["node_mask"],
-            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
-            b["incident_nodes"]))
+        logits = np.asarray(
+            (fwd if gnn.edges_sorted_by_dst(b["edge_dst"])
+             else fwd_unsorted)(
+                params, b["features"], b["node_kind"], b["node_mask"],
+                b["edge_src"], b["edge_dst"], b["edge_rel"],
+                b["edge_mask"], b["incident_nodes"]))
         pred = logits.argmax(-1)
         raw = backend.score_snapshot(b["snapshot"])
         oracle = np.asarray(raw["top_rule_index"])
