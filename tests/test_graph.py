@@ -280,3 +280,26 @@ def test_cleanup_500_incidents_is_fast_at_scale():
     # generous bound for a 1-core CI box; the O(N)-per-removal version
     # takes tens of seconds here
     assert dt < 2.0, f"cleanup took {dt:.2f}s — removal is not O(degree)"
+
+
+def test_snapshot_edges_sorted_by_dst_including_padding():
+    """build_snapshot's dst-sort contract: the ENTIRE edge_dst array is
+    non-decreasing (live prefix sorted, padding pinned to the last node
+    row), because gnn_backend keys the segment-sum sorted fast path off
+    gnn.edges_sorted_by_dst — breaking the sort would silently fall back
+    to the 1.9x-slower scatter, not fail."""
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+
+    snap = build_snapshot(_mini_store(), SMALL)
+    assert snap.num_edges > 0
+    d = snap.edge_dst
+    assert (d[1:] >= d[:-1]).all(), "edge_dst not globally non-decreasing"
+    assert gnn.edges_sorted_by_dst(d)
+    # padding rows target the last node row with zero mask
+    pad = snap.edge_mask == 0
+    if pad.any():
+        assert (d[pad] == snap.padded_nodes - 1).all()
+        assert (snap.edge_rel[pad] == -1).all()
+    # and the sort didn't drop or duplicate live edges
+    live = snap.edge_mask > 0
+    assert int(live.sum()) == snap.num_edges

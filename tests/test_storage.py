@@ -78,3 +78,40 @@ def test_action_upsert_idempotency():
     rows = db.actions_for(inc.id)
     assert len(rows) == 1 and rows[0]["status"] == "completed"
     db.close()
+
+
+def test_journal_workflows_rollup_and_limit():
+    """The workflow-listing rollup (inspection surface): per-workflow step
+    counts, the shared state precedence, durations summed, most recent
+    first, and the limit honored."""
+    db = Database(":memory:")
+    db.journal_put("wf-a", "s1", "completed", {"r": 1}, attempts=1,
+                   duration_s=0.5)
+    db.journal_put("wf-a", "s2", "failed", {"error": "x"}, attempts=3,
+                   duration_s=1.5)
+    db.journal_put("wf-b", "s1", "completed", None, attempts=1,
+                   duration_s=0.25)
+    import time
+    time.sleep(0.002)   # updated_at has ms precision; avoid a tie
+    db.journal_put("wf-c", "s1", "running", None, attempts=1)
+
+    listing = db.journal_workflows()
+    # most-recently-active first: wf-c was journaled last
+    assert listing[0]["workflow_id"] == "wf-c"
+    rows = {r["workflow_id"]: r for r in listing}
+    assert rows["wf-a"]["state"] == "failed"      # failed > completed
+    assert rows["wf-a"]["steps"] == 2
+    assert rows["wf-a"]["total_duration_s"] == 2.0
+    assert rows["wf-b"]["state"] == "completed"
+    assert rows["wf-c"]["state"] == "running"
+
+    assert len(db.journal_workflows(limit=2)) == 2
+    # shared precedence helper: one encoding for list, timeline, status
+    assert Database.rollup_state(0, 0, 0) == "pending"
+    assert Database.rollup_state(0, 1, 5) == "running"
+    assert Database.rollup_state(1, 1, 5) == "failed"
+
+    # journal_get surfaces duration + updated_at for the timeline
+    j = db.journal_get("wf-a")
+    assert j["s1"]["duration_s"] == 0.5 and j["s1"]["updated_at"]
+    db.close()
