@@ -98,8 +98,13 @@ class KubernetesCollector(BaseCollector):
                 "readiness_probe_failing": p.readiness_probe_failing,
                 "phase": p.phase,
                 "node": p.node,
-                "created_at": p.started_at.isoformat()
-                if p.started_at else None,
+                # reference contract (kubernetes_collector.py:162):
+                # created_at is metadata.creationTimestamp, NOT
+                # status.startTime — they differ for pending/late-started
+                # pods. The fake cluster tracks no separate creation time,
+                # so started_at stands in there (creation == start in sim).
+                "created_at": (p.creation_ts or p.started_at).isoformat()
+                if (p.creation_ts or p.started_at) else None,
                 **pod_detail(p),
             }
             result.evidence.append(self.make_evidence(

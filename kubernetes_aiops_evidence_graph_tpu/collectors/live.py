@@ -241,6 +241,8 @@ class LiveClusterBackend:
                 not_ready_seconds=not_ready_s,
                 readiness_probe_failing=probe_failing,
                 started_at=parse_iso(status["startTime"]) if status.get("startTime") else None,
+                creation_ts=parse_iso(meta["creationTimestamp"])
+                if meta.get("creationTimestamp") else None,
                 conditions=[{"type": c.get("type"), "status": c.get("status"),
                              "reason": c.get("reason")}
                             for c in status.get("conditions") or []],

@@ -64,6 +64,12 @@ class Settings:
     rca_backend: str = "tpu"                       # cpu|tpu|gnn (plugin seam, BASELINE.json north star)
     rca_propagation_hops: int = 3                  # graph depth analog (neo4j.py:174 maxLevel=3)
     gnn_checkpoint: str = ""                       # orbax dir for rca_backend=gnn
+    # relation-bucketed GNN message passing (gnn.py): False forces the
+    # transform-then-gather reference kernel (debug/parity escape hatch)
+    gnn_bucketed: bool = True
+    # "" = f32 matmuls; "bfloat16" = bf16 matmul operands with f32
+    # accumulation (segment-sum and residual stay f32)
+    gnn_compute_dtype: str = ""
     llm_provider: str = "none"                     # none|gemini|openai|ollama
     llm_api_key: str = ""
     llm_model: str = ""

@@ -28,6 +28,46 @@ from .schema import (
 )
 from .store import EvidenceGraphStore, _Node
 
+# Per-relation edge-slice capacity ladder (relation-bucketed layout): each
+# RelationKind's contiguous slice is padded to a ladder value so the static
+# offset tuple — a jit cache key for the bucketed GNN kernel — is drawn
+# from a small discrete set instead of minting a recompile per edge-count
+# drift. Powers of two up to 8192, then multiples of 8192: the bucketed
+# kernel's device time scales with PADDED edge rows (gather + scatter both
+# walk them), so big slices cap the inflation at ~6% instead of the ~2x a
+# pure power-of-two ladder costs (measured 459520 padded for 273238 live
+# at the 50k-node bench config; the stepped ladder lands at 287488).
+# 8192-multiples keep slice bases tile-aligned. Shared by build_snapshot,
+# parallel/partition.py and the streaming edge mirror
+# (rca/gnn_streaming.py).
+REL_SLICE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+_REL_SLICE_STEP = 8192
+
+
+def rel_slice_offsets(counts, slack: float = 0.0,
+                      min_cap: int = 0,
+                      buckets: tuple[int, ...] = REL_SLICE_BUCKETS,
+                      ) -> tuple[int, ...]:
+    """[R+1] static offsets for a relation-bucketed edge layout: slice r
+    spans ``[off[r], off[r+1])`` with capacity ``count_r`` rounded up the
+    ladder — power-of-two below its top rung, next multiple of
+    ``_REL_SLICE_STEP`` above — plus ``slack`` growth headroom. A
+    relation with no edges gets a zero-width slice unless ``min_cap``
+    reserves room (the streaming mirror does, so first-edge churn of a
+    new relation doesn't force an immediate re-mirror)."""
+    offs = [0]
+    step = max(int(buckets[-1]), _REL_SLICE_STEP)
+    for c in counts:
+        need = max(int(np.ceil(int(c) * (1.0 + slack))), min_cap)
+        if need <= 0:
+            cap = 0
+        elif need <= buckets[-1]:
+            cap = bucket_for(need, buckets)
+        else:
+            cap = -(-need // step) * step
+        offs.append(offs[-1] + cap)
+    return tuple(offs)
+
 
 def extract_node_features(node: _Node, now_s: float | None = None) -> np.ndarray:
     """Fold a node's property bag into the fixed feature vector.
@@ -123,6 +163,15 @@ class GraphSnapshot:
       edge_src   int32  [Pe]      edge_dst  int32 [Pe]   edge_rel int32 [Pe]
       edge_mask  f32    [Pe]      (padded edges self-loop on pad node 0 weight)
       incident_nodes int32 [Pi]   incident_mask f32 [Pi]
+
+    Edge layout contract (relation-bucketed): edges are sorted by
+    ``(rel, dst)`` and grouped into per-relation contiguous slices —
+    relation r owns ``[rel_offsets[r], rel_offsets[r+1])``, live prefix
+    dst-sorted, slice tail padded (mask 0, rel -1, dst pinned to the last
+    node row so each slice stays non-decreasing in dst). The static
+    ``rel_offsets`` tuple is what lets the GNN's bucketed kernel slice per
+    relation with one [H, H] matmul each (rca/gnn.py); COO consumers that
+    filter by mask/rel stay order-insensitive.
     """
     node_ids: tuple[str, ...]
     incident_ids: tuple[str, ...]
@@ -139,6 +188,7 @@ class GraphSnapshot:
     incident_nodes: np.ndarray
     incident_mask: np.ndarray
     version: int = 0
+    rel_offsets: tuple[int, ...] = ()   # [R+1] per-relation edge slices
 
     @property
     def padded_nodes(self) -> int:
@@ -208,24 +258,37 @@ def build_snapshot(
             raw_edges.append((d, s, int(e.kind)))
 
     m = len(raw_edges)
-    pe = bucket_for(max(m, 1), cfg.edge_bucket_sizes)
+    num_rels = len(RelationKind)
+    counts = np.zeros(num_rels, dtype=np.int64)
+    arr = np.asarray(raw_edges, dtype=np.int32) if m else None
+    if m:
+        counts = np.bincount(arr[:, 2], minlength=num_rels)
+    # relation-bucketed layout: live edges sorted by (rel, dst) into one
+    # contiguous padded slice per relation (static offsets). Each slice's
+    # live prefix is dst-sorted, so the GNN's per-slice segment-sums keep
+    # the indices_are_sorted fast path (measured 1.9x on the v5e scatter);
+    # COO consumers filter by mask/rel and stay order-insensitive.
+    rel_offsets = rel_slice_offsets(counts)
+    pe = max(int(rel_offsets[-1]), 1)
     edge_src = np.zeros(pe, dtype=np.int32)
-    # padding dst = LAST node row, not 0: keeps the whole dst array
-    # monotone after the live-prefix sort below (their mask-zeroed
-    # messages add 0.0 to that row either way)
+    # padding dst = LAST node row, not 0: keeps every slice monotone in
+    # dst through its padded tail (the mask-zeroed messages add 0.0 to
+    # that row either way)
     edge_dst = np.full(pe, pn - 1, dtype=np.int32)
     edge_rel = np.full(pe, -1, dtype=np.int32)
     edge_mask = np.zeros(pe, dtype=np.float32)
     if m:
-        arr = np.asarray(raw_edges, dtype=np.int32)
-        # live edges sorted by destination: COO consumers are
-        # order-insensitive, and dst-sorted indices let the GNN's
-        # segment-sum take the indices_are_sorted fast path (measured
-        # 1.9x on the v5e scatter; gnn.forward sorted_by_dst)
-        order = np.argsort(arr[:, 1], kind="stable")
+        order = np.lexsort((arr[:, 1], arr[:, 2]))   # rel major, dst minor
         arr = arr[order]
-        edge_src[:m], edge_dst[:m], edge_rel[:m] = arr[:, 0], arr[:, 1], arr[:, 2]
-        edge_mask[:m] = 1.0
+        pos = 0
+        for r in range(num_rels):
+            c = int(counts[r])
+            lo = rel_offsets[r]
+            edge_src[lo:lo + c] = arr[pos:pos + c, 0]
+            edge_dst[lo:lo + c] = arr[pos:pos + c, 1]
+            edge_rel[lo:lo + c] = arr[pos:pos + c, 2]
+            edge_mask[lo:lo + c] = 1.0
+            pos += c
 
     ni = len(incident_rows)
     pi = bucket_for(_pad(max(ni, 1)), cfg.incident_bucket_sizes)
@@ -251,4 +314,5 @@ def build_snapshot(
         incident_nodes=incident_nodes,
         incident_mask=incident_mask,
         version=store.version,
+        rel_offsets=rel_offsets,
     )

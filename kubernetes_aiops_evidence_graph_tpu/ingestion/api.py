@@ -326,8 +326,10 @@ class ApiHandler(BaseHTTPRequestHandler):
         done = [s["step"] for s in steps if s["status"] == "completed"]
         self._json(200, {
             "workflow_id": workflow_id,
-            "state": ("failed" if failed else "running" if running
-                      else "completed" if done else "pending"),
+            # the ONE shared precedence encoding — do not inline it here
+            # (it drifted once; ADVICE r5)
+            "state": self.app.db.rollup_state(
+                len(failed), len(running), len(done)),
             "total_duration_s": sum(s["duration_s"] or 0.0 for s in steps),
             "steps": steps,
         })

@@ -1,7 +1,14 @@
 from .propagate import k_hop_reach, propagate_labels
-from .segment import gather_neighbors, scatter_add, scatter_add_2d, scatter_max
+from .segment import (
+    gather_matmul_segment,
+    gather_neighbors,
+    scatter_add,
+    scatter_add_2d,
+    scatter_max,
+)
 
 __all__ = [
     "k_hop_reach", "propagate_labels",
     "scatter_add", "scatter_add_2d", "scatter_max", "gather_neighbors",
+    "gather_matmul_segment",
 ]

@@ -32,3 +32,57 @@ def scatter_add_2d(values: jax.Array, rows: jax.Array, cols: jax.Array,
 def gather_neighbors(x: jax.Array, index: jax.Array) -> jax.Array:
     """x[index] with index padded by any in-range value (mask separately)."""
     return x[index]
+
+
+def gather_matmul_segment(
+    h: jax.Array,              # [N, H] node table
+    w_rel: jax.Array,          # [R, H, K] per-relation transforms
+    src: jax.Array,            # [E] source index, relation-bucketed layout
+    dst: jax.Array,            # [E] destination/segment index
+    mask: jax.Array,           # [E] 1.0 live / 0.0 padding
+    rel_offsets: tuple[int, ...],   # [R+1] STATIC slice bounds into E
+    num_segments: int,
+    *,
+    slices_sorted: bool = False,
+    compute_dtype=None,
+) -> jax.Array:
+    """Fused gather → per-relation matmul → dst-segment-sum over a
+    relation-bucketed edge layout: edges are laid out so relation ``r``
+    owns the contiguous slice ``[rel_offsets[r], rel_offsets[r+1])`` (live
+    prefix + mask-zeroed padding). Each slice gathers its [E_r, H] source
+    rows, applies ONE [H, K] matmul, and segment-adds into the [N, K]
+    accumulator — compute and HBM traffic scale with E, never N·R (the
+    dense transform-then-gather kernel materializes all R transformed
+    copies of the node table: [N, R, H] written + re-read per layer).
+
+    ``rel_offsets`` must be a static tuple (bind before jitting);
+    ``slices_sorted=True`` promises dst is non-decreasing WITHIN each
+    slice, letting every per-slice scatter take the sorted fast path.
+    ``compute_dtype`` (e.g. jnp.bfloat16) casts the matmul operands only;
+    products and the segment accumulation stay in ``h.dtype`` (f32
+    accumulation), so precision loss is bounded to one rounding per
+    product term.
+    """
+    out_dtype = h.dtype
+    if compute_dtype is not None:
+        # cast ONCE before the gathers: the per-edge rows then move at
+        # compute-dtype width (half the gather bytes for bf16), and each
+        # matmul still accumulates into out_dtype via
+        # preferred_element_type
+        h = h.astype(compute_dtype)
+        w_rel = w_rel.astype(compute_dtype)
+        mask = mask.astype(compute_dtype)
+    agg = jnp.zeros((num_segments, w_rel.shape[-1]), out_dtype)
+    # promise_in_bounds: the layout contract guarantees src/dst < N (slice
+    # padding pins dst to the last row), so the gather/scatter skip the
+    # out-of-bounds clamp logic
+    for r in range(len(rel_offsets) - 1):
+        lo, hi = int(rel_offsets[r]), int(rel_offsets[r + 1])
+        if hi <= lo:
+            continue   # relation with no edges: zero-width slice
+        g = h.at[src[lo:hi]].get(mode="promise_in_bounds") \
+            * mask[lo:hi, None]
+        msg = jax.lax.dot(g, w_rel[r], preferred_element_type=out_dtype)
+        agg = agg.at[dst[lo:hi]].add(msg, indices_are_sorted=slices_sorted,
+                                     mode="promise_in_bounds")
+    return agg

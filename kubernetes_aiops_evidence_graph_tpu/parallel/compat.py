@@ -1,0 +1,21 @@
+"""jax API compatibility seam for ``shard_map``.
+
+Newer jax exports ``shard_map`` at top level with a ``check_vma`` kwarg;
+the 0.4.x line ships it under ``jax.experimental.shard_map`` with the same
+semantics spelled ``check_rep``. Every shard_map call in the repo goes
+through this one wrapper so the rest of the code can use the current
+spelling regardless of the installed jax.
+"""
+from __future__ import annotations
+
+try:                                 # jax >= 0.6: top-level, check_vma
+    from jax import shard_map as _shard_map
+    _REP_KW = "check_vma"
+except ImportError:                  # jax 0.4.x: experimental, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REP_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_REP_KW: check_vma})

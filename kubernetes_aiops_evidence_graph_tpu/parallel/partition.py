@@ -6,6 +6,13 @@ incoming messages is shard-local and only source embeddings cross shards
 via all-gather — the halo exchange). Incidents are round-robined over
 ``dp`` shards. All per-shard arrays are padded to a common static size so
 the shard_map'd step compiles once.
+
+Per-shard edges carry the same relation-bucketed layout as the snapshot
+(graph/snapshot.py): each shard's edges are sorted by (rel, dst_local)
+into per-relation slices whose capacities are shared across shards (max
+over shards, padded to the REL_SLICE_BUCKETS ladder) — one static
+``rel_offsets`` tuple therefore describes EVERY shard, which is what lets
+the shard_map'd bucketed kernel compile once.
 """
 from __future__ import annotations
 
@@ -13,7 +20,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..graph.snapshot import GraphSnapshot
+from ..graph.schema import RelationKind
+from ..graph.snapshot import GraphSnapshot, rel_slice_offsets
 from ..utils.padding import bucket_for
 
 
@@ -24,7 +32,8 @@ class PartitionedGraph:
     features: np.ndarray        # [G, Pn/G, DIM]
     node_kind: np.ndarray       # [G, Pn/G]
     node_mask: np.ndarray       # [G, Pn/G]
-    # graph axis: edges grouped by dst shard, dst made shard-local
+    # graph axis: edges grouped by dst shard, dst made shard-local,
+    # relation-bucketed per shard (shared static rel_offsets)
     edge_src: np.ndarray        # [G, Pe_shard] global src index
     edge_dst_local: np.ndarray  # [G, Pe_shard] dst - shard*Pn/G
     edge_rel: np.ndarray        # [G, Pe_shard] RelationKind (-1 = padding)
@@ -34,6 +43,7 @@ class PartitionedGraph:
     incident_mask: np.ndarray   # [D, Pi/D]
     labels: np.ndarray          # [D, Pi/D]
     nodes_per_shard: int
+    rel_offsets: tuple[int, ...] = ()   # [R+1] shared per-shard slices
 
 
 def partition_snapshot(
@@ -56,21 +66,37 @@ def partition_snapshot(
     dst = snapshot.edge_dst[live]
     rel = snapshot.edge_rel[live]
     owner = dst // nps
-    counts = np.bincount(owner, minlength=graph)
-    pe_shard = bucket_for(max(int(counts.max()) if counts.size else 1, 1),
-                          (256, 1024, 4096, 16384, 65536, 262144))
+    num_rels = len(RelationKind)
+    # shared per-relation capacities: the max count over shards, bucketed
+    counts = np.zeros((graph, num_rels), np.int64)
+    for g in range(graph):
+        sel = owner == g
+        if sel.any():
+            counts[g] = np.bincount(rel[sel], minlength=num_rels)
+    rel_offsets = rel_slice_offsets(counts.max(axis=0) if len(src) else
+                                    np.zeros(num_rels, np.int64))
+    pe_shard = max(int(rel_offsets[-1]), 1)
 
     e_src = np.zeros((graph, pe_shard), np.int32)
-    e_dst = np.zeros((graph, pe_shard), np.int32)
+    # padding dst_local = LAST local row: keeps each slice non-decreasing
+    # in dst through its padded tail (mask-zeroed adds either way)
+    e_dst = np.full((graph, pe_shard), nps - 1, np.int32)
     e_rel = np.full((graph, pe_shard), -1, np.int32)
     e_mask = np.zeros((graph, pe_shard), np.float32)
     for g in range(graph):
         sel = owner == g
-        k = int(sel.sum())
-        e_src[g, :k] = src[sel]
-        e_dst[g, :k] = dst[sel] - g * nps
-        e_rel[g, :k] = rel[sel]
-        e_mask[g, :k] = 1.0
+        gs, gd, gr = src[sel], dst[sel] - g * nps, rel[sel]
+        order = np.lexsort((gd, gr))       # rel major, dst_local minor
+        gs, gd, gr = gs[order], gd[order], gr[order]
+        pos = 0
+        for r in range(num_rels):
+            c = int(counts[g, r])
+            lo = rel_offsets[r]
+            e_src[g, lo:lo + c] = gs[pos:pos + c]
+            e_dst[g, lo:lo + c] = gd[pos:pos + c]
+            e_rel[g, lo:lo + c] = gr[pos:pos + c]
+            e_mask[g, lo:lo + c] = 1.0
+            pos += c
 
     pi = snapshot.padded_incidents
     per_dp = -(-pi // dp)
@@ -93,4 +119,5 @@ def partition_snapshot(
         edge_mask=e_mask,
         incident_nodes=inc_nodes, incident_mask=inc_mask, labels=lab,
         nodes_per_shard=nps,
+        rel_offsets=rel_offsets,
     )

@@ -25,14 +25,26 @@ annotate shardings, let XLA place collectives):
   collectives (psum of the all-gather transpose = reduce-scatter; the
   ppermute transpose = counter-rotation) are inserted by XLA
   automatically; parameters stay replicated.
+
+Both halo strategies support BOTH relation-kernel mappings (rca/gnn.py
+module docstring): pass ``rel_offsets`` (the PartitionedGraph's shared
+per-shard slice table, a static tuple) to run the relation-bucketed
+kernel — per-slice gather → one [H, H] matmul per relation → shard-local
+segment-sum; omit it for the transform-then-gather reference. The
+reference mode stays bit-identical to single-device (one shared kernel,
+same edge order); the bucketed mode accumulates per relation slice, whose
+per-shard edge order differs from the single-device layout, so parity is
+within float tolerance (~1e-5 on the loss) rather than bit-exact — pinned
+by tests/test_parallel.py.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
+from .compat import shard_map
+from ..ops.segment import gather_matmul_segment
 from ..rca import gnn
 
 
@@ -40,15 +52,19 @@ def _ring_perm(d: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % d) for i in range(d)]
 
 
-def _ring_messages(h_local, w_rel, esrc, erel, emask, edst_local, d: int):
-    """Ring halo exchange with the relation-aware transform-then-gather
-    mapping (same rewrite as gnn._message_pass — TPU scatters serialize,
-    so per-(dst, relation) scatter buckets measured 9.4x slower): each
-    step transforms the in-flight block by ALL R relation matrices (one
-    MXU einsum), every in-block edge gathers its rel-specific source row
-    (flattened 1-D gather), and aggregation stays a single [E, H]
-    segment-sum into local dst rows. The ring still moves only [nps, H]
-    blocks — communication is unchanged.
+def _ring_messages(h_local, w_rel, esrc, erel, emask, edst_local, d: int,
+                   rel_offsets=None, slices_sorted: bool = False):
+    """Ring halo exchange; relation kernel per ``rel_offsets`` (module
+    docstring). Reference mode is the transform-then-gather mapping (same
+    rewrite as gnn._message_pass — TPU scatters serialize, so
+    per-(dst, relation) scatter buckets measured 9.4x slower): each step
+    transforms the in-flight block by ALL R relation matrices (one MXU
+    einsum), every in-block edge gathers its rel-specific source row, and
+    aggregation stays a single [E, H] segment-sum into local dst rows.
+    Bucketed mode replaces that with the fused per-slice gather-matmul-
+    segment kernel over the in-flight block (mask = emask * in_block).
+    Either way the ring moves only [nps, H] blocks — communication is
+    unchanged.
 
     Step r holds shard ((my - r) mod d)'s embedding block; edges whose
     global src index falls in that shard's range consume it, then the block
@@ -63,9 +79,14 @@ def _ring_messages(h_local, w_rel, esrc, erel, emask, edst_local, d: int):
         lo = src_shard * nps
         in_block = ((esrc >= lo) & (esrc < lo + nps)).astype(h_block.dtype)
         local_src = jnp.clip(esrc - lo, 0, nps - 1)
-        msg = gnn.rel_messages(h_block, w_rel, local_src, rel,
-                               emask * in_block)
-        agg = agg.at[edst_local].add(msg)
+        if rel_offsets is not None:
+            agg = agg + gather_matmul_segment(
+                h_block, w_rel, local_src, edst_local, emask * in_block,
+                rel_offsets, nps, slices_sorted=slices_sorted)
+        else:
+            msg = gnn.rel_messages(h_block, w_rel, local_src, rel,
+                                   emask * in_block)
+            agg = agg.at[edst_local].add(msg)
         h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(d))
         return h_block, agg
 
@@ -97,11 +118,16 @@ def _ring_readout(h_local, inc_nodes, d: int):
     return emb
 
 
-def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
-    """Build the shard_map'd loss over local shards."""
+def _sharded_loss(mesh: Mesh, halo: str = "allgather", rel_offsets=None,
+                  slices_sorted: bool = False):
+    """Build the shard_map'd loss over local shards. ``rel_offsets`` (the
+    PartitionedGraph's shared static slice table) selects the
+    relation-bucketed kernel for both halo strategies."""
     if halo not in ("allgather", "ring"):
         raise ValueError(f"halo must be allgather|ring, got {halo!r}")
     graph_size = mesh.shape["graph"]
+    if rel_offsets is not None:
+        rel_offsets = tuple(int(o) for o in rel_offsets)
 
     def local_loss(params, feats, kind, nmask, esrc, edst_local, erel,
                    emask, inc_nodes, inc_mask, labels):
@@ -122,14 +148,21 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
 
         for layer in params["layers"]:
             # halo exchange: every shard needs src embeddings of its
-            # in-edges. Both strategies use the transform-then-gather
-            # relation mapping (see _ring_messages / gnn._message_pass);
-            # the all-gather still moves only [N, H] — the R transformed
-            # copies are recomputed shard-locally (replicated FLOPs are
-            # MXU-cheap, replicated comm is not)
+            # in-edges. Both strategies support both relation mappings
+            # (see _ring_messages / gnn module docstring); the all-gather
+            # still moves only [N, H] — per-relation compute is
+            # recomputed shard-locally (replicated FLOPs are MXU-cheap,
+            # replicated comm is not)
             if halo == "ring":
                 agg = _ring_messages(h_local, layer["w_rel"], esrc, erel,
-                                     emask, edst_local, graph_size)
+                                     emask, edst_local, graph_size,
+                                     rel_offsets=rel_offsets,
+                                     slices_sorted=slices_sorted)
+            elif rel_offsets is not None:
+                h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
+                agg = gather_matmul_segment(
+                    h_full, layer["w_rel"], esrc, edst_local, emask,
+                    rel_offsets, nps, slices_sorted=slices_sorted)
             else:
                 h_full = jax.lax.all_gather(h_local, "graph", tiled=True)
                 msg = gnn.rel_messages(h_full, layer["w_rel"], esrc, erel,
@@ -167,9 +200,13 @@ def _sharded_loss(mesh: Mesh, halo: str = "allgather"):
     )
 
 
-def make_sharded_train_step(mesh: Mesh, tx, halo: str = "allgather"):
-    """jitted (params, opt_state, part: PartitionedGraph arrays) -> step."""
-    sharded_loss = _sharded_loss(mesh, halo=halo)
+def make_sharded_train_step(mesh: Mesh, tx, halo: str = "allgather",
+                            rel_offsets=None, slices_sorted: bool = False):
+    """jitted (params, opt_state, part: PartitionedGraph arrays) -> step.
+    Pass ``rel_offsets=part.rel_offsets`` to train on the
+    relation-bucketed kernel (see _sharded_loss)."""
+    sharded_loss = _sharded_loss(mesh, halo=halo, rel_offsets=rel_offsets,
+                                 slices_sorted=slices_sorted)
 
     def loss_scalar(params, *arrs):
         return sharded_loss(params, *arrs).mean()

@@ -23,7 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .compat import shard_map
 
 from ..rca.tpu_backend import DeviceBatch, _score_device
 

@@ -76,23 +76,55 @@ def fold_accounting(pi: int, width: int, pair_width: int, dim: int,
             "reads": reads, "writes": writes}
 
 
-def gnn_layer_accounting(pn: int, e: int, hidden: int) -> dict:
-    """Minimum HBM bytes + FLOPs of one `gnn._message_pass` layer
-    (relation-aware, transform-then-gather formulation: all R = NUM_RELS
-    transformed copies are computed densely, each edge gathers its
-    rel-specific source row, aggregation is one [E, H] segment-sum).
+def gnn_layer_accounting(pn: int, e: int, hidden: int,
+                         bucketed: bool = False,
+                         compute_bytes: int = 4) -> dict:
+    """Minimum HBM bytes + FLOPs of one GNN message-passing layer.
 
-    reads  — h for the two matmuls + residual 3*Pn*H, weights
-             R*H*H + H*H + H, transformed-copy gather E*H (from the
-             [Pn*R, H] table), edge mask + rel 2E, inv_deg Pn;
-    writes — transformed copies Pn*R*H, scatter accumulator Pn*H (plus
-             E*H read-modify-write traffic, counted once as E*H), layer
-             output Pn*H.
-    FLOPs — relation einsum 2*Pn*R*H*H, w_self matmul 2*Pn*H*H, mask
-            multiply E*H, scatter adds E*H, degree scale Pn*H,
-            bias+relu+residual 3*Pn*H.
+    ``bucketed=False`` — the reference transform-then-gather mapping
+    (`gnn._message_pass`): all R = NUM_RELS transformed copies computed
+    densely, each edge gathers its rel-specific source row, one [E, H]
+    segment-sum.
+      reads  — h for the two matmuls + residual 3*Pn*H, weights
+               R*H*H + H*H + H, transformed-copy gather E*H (from the
+               [Pn*R, H] table), edge mask + rel 2E, inv_deg Pn;
+      writes — transformed copies Pn*R*H, scatter accumulator Pn*H (plus
+               E*H read-modify-write traffic, counted once as E*H), layer
+               output Pn*H.
+      FLOPs  — relation einsum 2*Pn*R*H*H, w_self matmul 2*Pn*H*H, mask
+               multiply E*H, scatter adds E*H, degree scale Pn*H,
+               bias+relu+residual 3*Pn*H.
+
+    ``bucketed=True`` — the relation-bucketed mapping
+    (`gnn._message_pass_bucketed`): per-relation slices gather [E_r, H]
+    source rows, one [H, H] matmul each, per-slice segment-sums into one
+    [N, H] accumulator. No [Pn, R, H] term anywhere — edge traffic scales
+    with E (here ``e`` = the SUM of padded slice capacities,
+    snapshot.rel_offsets[-1]).
+      reads  — source-row gather E*H, h for self matmul + residual
+               2*Pn*H, weights (R+1)*H*H + H, messages re-read by the
+               scatter E*H, src+dst indices 2E, mask E, inv_deg Pn;
+      writes — messages E*H, scatter accumulator Pn*H (RMW counted once
+               as E*H), layer output Pn*H.
+      FLOPs  — slice matmuls 2*E*H*H, w_self matmul 2*Pn*H*H, mask
+               multiply E*H, scatter adds E*H, degree scale + bias +
+               relu + residual 4*Pn*H.
+
+    ``compute_bytes`` scales the matmul-OPERAND traffic terms (gathered
+    rows, weights, message writes/reads) for the bf16 compute path (pass
+    2); accumulator/output/index traffic stays f32/int32 at 4 bytes.
     """
     from .gnn import NUM_RELS as r
+    if bucketed:
+        cb = compute_bytes
+        reads = (e * hidden * cb + 2 * pn * hidden * 4
+                 + ((r + 1) * hidden * hidden + hidden) * cb
+                 + e * hidden * cb + 3 * e * 4 + pn * 4)
+        writes = (e * hidden * cb + (e + 2 * pn) * hidden * 4)
+        flops = (2 * e * hidden * hidden + 2 * pn * hidden * hidden
+                 + 2 * e * hidden + 4 * pn * hidden)
+        return {"bytes": reads + writes, "flops": flops,
+                "reads": reads, "writes": writes}
     reads = (3 * pn * hidden + r * hidden * hidden + hidden * hidden
              + hidden + e * hidden + 2 * e + pn) * 4
     writes = (pn * r * hidden + 2 * pn * hidden + e * hidden) * 4
@@ -240,37 +272,53 @@ def measure_scan_per_pass_s(batch: DeviceBatch, device_args: tuple,
 
 
 def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
-                                   k2: int = 16) -> float:
+                                   k2: int = 16, bucketed: bool = False,
+                                   compute_dtype: str | None = None) -> float:
     """Device-only per-forward seconds of the full GNN (all layers), via a
     scanned forward whose input features are scaled by
     ``1 + mean_logit * 1e-38`` — exactly 1.0 in f32 (the product
     underflows the 2^-24 ulp at 1.0), so results are unchanged, but the
     compiler cannot prove it, which makes every layer loop-variant (no
     hoisting; see _scan_score). Only the degree normalization (an O(E)
-    add) is invariant and hoistable — noise next to the matmuls."""
+    add) is invariant and hoistable — noise next to the matmuls.
+
+    ``bucketed=True`` times the relation-bucketed kernel on the
+    snapshot's (rel, dst) layout (with the optional bf16
+    ``compute_dtype``); False times the transform-then-gather reference
+    on the same arrays — the two are directly comparable because both
+    consume identical inputs."""
     from . import gnn
     b = gnn.snapshot_batch(snapshot)
     args = tuple(jnp.asarray(b[key]) for key in (
         "features", "node_kind", "node_mask", "edge_src", "edge_dst",
         "edge_rel", "edge_mask", "incident_nodes"))
 
-    sorted_by_dst = gnn.edges_sorted_by_dst(b["edge_dst"])
+    offs = tuple(b.get("rel_offsets") or ()) if bucketed else None
+    if bucketed and not offs:
+        raise ValueError("bucketed=True needs a relation-bucketed snapshot")
+    sorted_by_dst = (not bucketed) and gnn.edges_sorted_by_dst(b["edge_dst"])
+    slices_sorted = bool(offs) and gnn.slices_sorted_by_dst(
+        b["edge_dst"], offs)
 
-    @partial(jax.jit, static_argnames=("k", "sorted_"))
+    @partial(jax.jit, static_argnames=("k", "sorted_", "offs", "ss", "cd"))
     def scan_fwd(params, features, node_kind, node_mask, edge_src, edge_dst,
-                 edge_rel, edge_mask, incident_nodes, k: int, sorted_: bool):
+                 edge_rel, edge_mask, incident_nodes, k: int, sorted_: bool,
+                 offs, ss: bool, cd):
         def body(carry, _):
             f = features * (1.0 + carry * 1e-38)
             logits = gnn.forward(params, f, node_kind, node_mask,
                                  edge_src, edge_dst, edge_rel, edge_mask,
-                                 incident_nodes, sorted_by_dst=sorted_)
+                                 incident_nodes, sorted_by_dst=sorted_,
+                                 rel_offsets=offs, slices_sorted=ss,
+                                 compute_dtype=cd)
             return logits.mean(), None
         last, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
         return last
 
     def run(k: int) -> float:
         t0 = time.perf_counter()
-        out = scan_fwd(params, *args, k=k, sorted_=sorted_by_dst)
+        out = scan_fwd(params, *args, k=k, sorted_=sorted_by_dst,
+                       offs=offs, ss=slices_sorted, cd=compute_dtype)
         jax.device_get(out)
         return time.perf_counter() - t0
 

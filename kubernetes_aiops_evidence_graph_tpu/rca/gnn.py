@@ -10,11 +10,43 @@ an incident node's OWN evidence arrives over AFFECTS edges while the
 deployment/service commons arrive over OWNS/SELECTS/SCHEDULED_ON paths —
 a relation-blind mean blends them, and measurably confuses incident pairs
 sharing a deployment (round-4 holdout: every miss predicted its
-deployment-mate's rule). The per-relation math is mapped as
-transform-then-gather: R stacked MXU matmuls produce every relation's
-transformed copy, each edge gathers its rel-specific source row, and
-aggregation stays one [E, H] segment-sum (see _message_pass for the
-measured 9.4x penalty of the scatter-bucket alternative).
+deployment-mate's rule).
+
+Two mappings of the per-relation math, selected by the snapshot layout
+(settings.gnn_bucketed is the escape hatch back to the reference):
+
+* **Relation-bucketed (the hot path)** — build_snapshot lays edges out
+  sorted by (rel, dst) with a STATIC per-relation offset table, so each
+  relation is a contiguous edge slice: gather h[src] per slice
+  ([E_r, H]), ONE [H, H] MXU matmul per relation, and per-slice
+  dst-segment-sums into a single [N, H] accumulator
+  (ops.gather_matmul_segment). Compute and HBM traffic scale with E, not
+  N·R. This is NOT the scatter-bucket loser (see below): there are no
+  2-D scatters anywhere — slices are static, scatters stay 1-D and
+  per-slice dst-sorted. At the 50k-node/500-incident bench config this
+  kills the reference's per-layer [N, R, H] materialization (151 MB
+  written + re-read; 508 -> 365 MB/layer floor-model traffic), shrinks
+  the row-addressed gather table 9.4x ([Pn*R, H] 151 MB -> [Pn, H]
+  16 MB — small enough to live near the compute instead of streaming
+  from HBM per row), and cuts the padded edge count 1.82x (524288 ->
+  287488; gathers and scatters both walk padded rows, and TPU row ops
+  serialize — they, not the MXU work, are what held the reference to
+  7.8% of roofline). Optional bf16 compute path: matmul operands cast
+  once before the gathers (half the per-row gather bytes), f32
+  accumulation in the segment-sum. Measured numbers live in BENCH
+  (bench.py reports reference vs bucketed vs bf16 on the same snapshot
+  each run).
+* **Transform-then-gather (reference)** — R stacked MXU matmuls produce
+  every relation's transformed copy ([N, R, H] einsum), each edge
+  gathers its rel-specific source row, aggregation is one [E, H]
+  segment-sum. Kept as the parity oracle behind the
+  settings.gnn_bucketed flag; round-5 BENCH measured it at 7.8% of the
+  roofline floor (41.0 ms/forward, 49.6 GB/s achieved on a 635.8 GB/s
+  part) — the gap this rewrite exists to close.
+* the scatter-bucket alternative — scatter messages into per-(node,
+  relation) buckets with a 2-D index — measured 9.4x SLOWER than the
+  reference (291 ms vs 31 ms at the 58k-node config on v5e-1): TPU
+  scatters serialize, matmuls don't. See _message_pass.
 
 Complements the deterministic ruleset backend with a trainable one
 (HypothesisSource.GNN); simulator scenarios provide labeled training data.
@@ -25,6 +57,7 @@ lives here device-agnostic, the multi-chip sharded training step lives in
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import jax
@@ -63,13 +96,16 @@ def init_params(key: jax.Array, hidden: int = 64, layers: int = 3) -> Params:
 
 def rel_messages(h_table, w_rel, src_index, edge_rel, edge_mask):
     """[E, H] per-edge messages under the transform-then-gather mapping —
-    THE one implementation of the relation-aware kernel (see
-    _message_pass for why the scatter-bucket alternative lost 9.4x):
-    every relation's transformed copy of ``h_table`` is computed densely
-    (stacked MXU matmuls), then each edge gathers its rel-specific source
-    row via the flattened index. Shared by the single-device layer and
-    both sharded halo strategies (parallel/sharded_gnn.py), so the
-    bit-identical-to-single-device invariant rests on one kernel."""
+    the REFERENCE implementation of the relation-aware kernel (see
+    _message_pass for why the scatter-bucket alternative lost 9.4x, and
+    the module docstring for the relation-bucketed hot path that
+    supersedes this one on bucketed layouts): every relation's
+    transformed copy of ``h_table`` is computed densely (stacked MXU
+    matmuls), then each edge gathers its rel-specific source row via the
+    flattened index. Shared by the single-device reference layer and both
+    sharded halo strategies' reference mode (parallel/sharded_gnn.py), so
+    the bit-identical-to-single-device invariant of that mode rests on
+    one kernel."""
     rel = jnp.clip(edge_rel, 0, NUM_RELS - 1)
     hr = jnp.einsum("nh,rhk->nrk", h_table, w_rel)      # [N, R, H]
     flat = hr.reshape(h_table.shape[0] * NUM_RELS, h_table.shape[1])
@@ -96,6 +132,30 @@ def _message_pass(h, layer, edge_src, edge_dst, edge_rel, edge_mask,
     return jax.nn.relu(h @ layer["w_self"] + agg + layer["b"]) + h
 
 
+def _message_pass_bucketed(h, layer, edge_src, edge_dst, edge_mask,
+                           rel_offsets, inv_deg, slices_sorted: bool,
+                           compute_dtype):
+    """One relation-aware round over the relation-bucketed edge layout
+    (module docstring): the fused gather → per-relation matmul →
+    per-slice segment-sum helper replaces both the dense [N, R, H]
+    transform AND the [E, H] message materialization of the reference
+    mapping. ``edge_rel`` is not consumed — the static slices imply the
+    relation. ``compute_dtype`` (e.g. "bfloat16") casts matmul operands
+    only; accumulation stays f32."""
+    from ..ops.segment import gather_matmul_segment
+    agg = gather_matmul_segment(
+        h, layer["w_rel"], edge_src, edge_dst, edge_mask, rel_offsets,
+        h.shape[0], slices_sorted=slices_sorted,
+        compute_dtype=compute_dtype) * inv_deg[:, None]
+    if compute_dtype is not None:
+        self_t = jax.lax.dot(h.astype(compute_dtype),
+                             layer["w_self"].astype(compute_dtype),
+                             preferred_element_type=h.dtype)
+    else:
+        self_t = h @ layer["w_self"]
+    return jax.nn.relu(self_t + agg + layer["b"]) + h
+
+
 def forward(
     params: Params,
     features: jax.Array,        # [N, DIM] f32
@@ -108,15 +168,28 @@ def forward(
     incident_nodes: jax.Array,  # [B] i32
     *,
     sorted_by_dst: bool = False,
+    rel_offsets: tuple[int, ...] | None = None,
+    slices_sorted: bool = False,
+    compute_dtype: str | None = None,
 ) -> jax.Array:
     """Logits [B, NUM_CLASSES] for each incident node.
 
-    ``sorted_by_dst=True`` (STATIC — bind it via functools.partial before
-    jitting) promises edge_dst is non-decreasing, letting every
-    segment-sum take the sorted fast path (measured 1.9x on the v5e
-    scatter). build_snapshot emits dst-sorted edges, so snapshot-based
-    scoring can pass it; the streaming edge mirror is slot-ordered and
-    must not."""
+    All keyword args are STATIC — bind them via functools.partial /
+    static_argnames before jitting:
+
+    * ``rel_offsets`` — a [R+1] tuple of per-relation edge-slice bounds
+      switches to the relation-bucketed kernel (module docstring; edges
+      MUST be laid out per the snapshot's (rel, dst) contract).
+      ``slices_sorted=True`` additionally promises dst is non-decreasing
+      within each slice (build_snapshot guarantees it; the streaming
+      mirror, whose slots are reused under churn, must not).
+      ``compute_dtype`` (e.g. "bfloat16") casts matmul operands only —
+      accumulation stays f32.
+    * ``sorted_by_dst=True`` (reference path only) promises the WHOLE
+      edge_dst is non-decreasing, letting every segment-sum take the
+      sorted fast path (measured 1.9x on the v5e scatter). Only a
+      globally dst-sorted layout (pre-bucketing snapshots) satisfies it.
+    """
     deg = jax.ops.segment_sum(edge_mask, edge_dst,
                               num_segments=features.shape[0],
                               indices_are_sorted=sorted_by_dst)
@@ -125,8 +198,14 @@ def forward(
                     + params["kind_emb"][node_kind])
     h = h * node_mask[:, None]
     for layer in params["layers"]:
-        h = _message_pass(h, layer, edge_src, edge_dst, edge_rel,
-                          edge_mask, inv_deg, sorted_by_dst=sorted_by_dst)
+        if rel_offsets is not None:
+            h = _message_pass_bucketed(h, layer, edge_src, edge_dst,
+                                       edge_mask, rel_offsets, inv_deg,
+                                       slices_sorted, compute_dtype)
+        else:
+            h = _message_pass(h, layer, edge_src, edge_dst, edge_rel,
+                              edge_mask, inv_deg,
+                              sorted_by_dst=sorted_by_dst)
     return h[incident_nodes] @ params["head_w"] + params["head_b"]
 
 
@@ -134,10 +213,17 @@ def loss_fn(
     params: Params,
     features, node_kind, node_mask, edge_src, edge_dst, edge_rel,
     edge_mask, incident_nodes, labels, label_mask,
+    *,
+    rel_offsets: tuple[int, ...] | None = None,
+    slices_sorted: bool = False,
+    compute_dtype: str | None = None,
 ) -> jax.Array:
-    """Masked mean cross-entropy over incident rows."""
+    """Masked mean cross-entropy over incident rows (static kwargs as in
+    :func:`forward`)."""
     logits = forward(params, features, node_kind, node_mask,
-                     edge_src, edge_dst, edge_rel, edge_mask, incident_nodes)
+                     edge_src, edge_dst, edge_rel, edge_mask, incident_nodes,
+                     rel_offsets=rel_offsets, slices_sorted=slices_sorted,
+                     compute_dtype=compute_dtype)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
     return (nll * label_mask).sum() / jnp.maximum(label_mask.sum(), 1.0)
@@ -145,16 +231,24 @@ def loss_fn(
 
 def make_train_step(tx):
     """Single-device train step (optax transform tx); the sharded variant is
-    parallel.sharded_gnn.make_sharded_train_step."""
+    parallel.sharded_gnn.make_sharded_train_step.
 
-    @jax.jit
-    def step(params, opt_state, batch):
+    ``rel_offsets``/``slices_sorted`` are static jit keys: pass the
+    batch's offset tuple (NOT inside the batch pytree — tuple ints would
+    trace) to train through the bucketed kernel; the per-relation ladder
+    (graph/snapshot.py REL_SLICE_BUCKETS) keeps the distinct-tuple count
+    — and so the compile count — small across episodes."""
+
+    @partial(jax.jit, static_argnames=("rel_offsets", "slices_sorted"))
+    def step(params, opt_state, batch, rel_offsets=None,
+             slices_sorted: bool = False):
         loss, grads = jax.value_and_grad(loss_fn)(
             params,
             batch["features"], batch["node_kind"], batch["node_mask"],
             batch["edge_src"], batch["edge_dst"], batch["edge_rel"],
             batch["edge_mask"],
             batch["incident_nodes"], batch["labels"], batch["label_mask"],
+            rel_offsets=rel_offsets, slices_sorted=slices_sorted,
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
@@ -172,15 +266,62 @@ def edges_sorted_by_dst(edge_dst) -> bool:
     return bool((d[1:] >= d[:-1]).all())
 
 
+def slices_sorted_by_dst(edge_dst, rel_offsets: tuple[int, ...]) -> bool:
+    """Host-side check of the per-slice sorted promise for the bucketed
+    kernel: dst non-decreasing WITHIN each relation slice (the global
+    array is deliberately not sorted — slices restart at low rows)."""
+    import numpy as np
+    d = np.asarray(edge_dst)
+    return all(
+        bool((d[lo + 1:hi] >= d[lo:hi - 1]).all())
+        for lo, hi in zip(rel_offsets[:-1], rel_offsets[1:]) if hi - lo > 1)
+
+
+_jit_forward = None
+
+
+def forward_batch(params: Params, batch: dict, *, bucketed: bool = True,
+                  compute_dtype: str | None = None) -> jax.Array:
+    """Score one snapshot batch with the best kernel for its layout.
+
+    One shared dispatcher (gnn_backend, the trainer's eval paths and the
+    oracle crosscheck all route through it): batches carrying a
+    ``rel_offsets`` tuple take the relation-bucketed kernel (with the
+    per-slice sorted fast path when the layout satisfies it); everything
+    else — including ``bucketed=False``, the reference escape hatch —
+    takes transform-then-gather with the global-sort fast path when the
+    layout allows. All variants share ONE jitted callable keyed on the
+    static args."""
+    global _jit_forward
+    if _jit_forward is None:
+        _jit_forward = jax.jit(forward, static_argnames=(
+            "sorted_by_dst", "rel_offsets", "slices_sorted",
+            "compute_dtype"))
+    args = (params, batch["features"], batch["node_kind"],
+            batch["node_mask"], batch["edge_src"], batch["edge_dst"],
+            batch["edge_rel"], batch["edge_mask"], batch["incident_nodes"])
+    offs = tuple(batch.get("rel_offsets") or ())
+    if bucketed and offs:
+        return _jit_forward(
+            *args, rel_offsets=offs,
+            slices_sorted=slices_sorted_by_dst(batch["edge_dst"], offs),
+            compute_dtype=compute_dtype)
+    return _jit_forward(
+        *args, sorted_by_dst=edges_sorted_by_dst(batch["edge_dst"]))
+
+
 def snapshot_batch(snapshot, labels=None) -> dict:
     """Pack a GraphSnapshot (+ optional int labels per incident) into the
-    array batch consumed by forward/loss."""
+    array batch consumed by forward/loss. ``rel_offsets`` rides along as a
+    plain tuple — strip it (make_train_step) or route through
+    forward_batch before handing the dict to jit as a pytree."""
     import numpy as np
     n_inc = snapshot.padded_incidents
     lab = np.full(n_inc, NUM_CLASSES - 1, dtype=np.int32)
     if labels is not None:
         lab[:len(labels)] = np.asarray(labels, dtype=np.int32)
     return {
+        "rel_offsets": tuple(getattr(snapshot, "rel_offsets", ()) or ()),
         "features": snapshot.features,
         "node_kind": snapshot.node_kind,
         "node_mask": snapshot.node_mask,

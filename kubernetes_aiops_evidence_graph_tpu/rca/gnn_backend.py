@@ -42,9 +42,9 @@ class GnnRcaBackend:
 
     def __init__(self, params: gnn.Params | None = None,
                  settings=None) -> None:
+        from ..config import get_settings
+        cfg = settings or get_settings()
         if params is None:
-            from ..config import get_settings
-            cfg = settings or get_settings()
             path = cfg.gnn_checkpoint or _shipped_checkpoint()
             if not path:
                 raise ValueError(
@@ -64,22 +64,20 @@ class GnnRcaBackend:
                     "rca/train.py or point KAEG_GNN_CHECKPOINT at a current "
                     "checkpoint")
         self.params = params
-        # build_snapshot emits dst-sorted edges -> sorted segment-sum
-        # fast path; gnn.edges_sorted_by_dst guards the promise per
-        # snapshot (checked once per scoring call — O(E) host scan,
-        # noise next to tensorization)
-        self._forward = jax.jit(partial(gnn.forward, sorted_by_dst=True))
-        self._forward_unsorted = jax.jit(gnn.forward)
+        # kernel selection is per-batch via gnn.forward_batch: snapshots
+        # carry the relation-bucketed layout (rel_offsets) and take the
+        # bucketed kernel unless settings.gnn_bucketed turns it off (the
+        # reference transform-then-gather escape hatch); layout promises
+        # (per-slice / global dst sort) are host-checked per call — an
+        # O(E) scan, noise next to tensorization.
+        self._bucketed = bool(getattr(cfg, "gnn_bucketed", True))
+        self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
 
     def score_snapshot(self, snapshot) -> dict:
         """Same keys as TpuRcaBackend.score_snapshot where meaningful."""
         b = gnn.snapshot_batch(snapshot)
-        fwd = self._forward if gnn.edges_sorted_by_dst(b["edge_dst"]) \
-            else self._forward_unsorted
-        logits = fwd(
-            self.params, b["features"], b["node_kind"], b["node_mask"],
-            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
-            b["incident_nodes"])
+        logits = gnn.forward_batch(self.params, b, bucketed=self._bucketed,
+                                   compute_dtype=self._compute_dtype)
         probs = np.asarray(jax.nn.softmax(logits, axis=-1))
         n = snapshot.num_incidents
         pred = probs.argmax(axis=-1)

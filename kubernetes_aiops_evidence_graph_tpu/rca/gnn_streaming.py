@@ -6,7 +6,13 @@ the resident `StreamingScorer` (rca/streaming.py) so the GNN shares its
 device-resident feature matrix and O(change) bookkeeping, and adds the one
 piece of state the rules fold never needed: a device-resident **edge
 mirror** (the full COO the message passing consumes — CALLS/OWNS/
-SCHEDULED_ON/..., both directions, exactly as `build_snapshot` emits them).
+SCHEDULED_ON/..., both directions). The mirror carries the same
+relation-bucketed layout as `build_snapshot` (static per-relation slice
+offsets; see _mirror_init) so the tick runs the E-scaled bucketed kernel
+— slots allocate from per-relation free lists, which keeps the static
+offsets valid under churn, with a full re-mirror as the region-overflow
+fallback. Within-slice dst order is NOT maintained under churn, so the
+tick never claims the sorted-scatter fast path.
 
 Why a full re-embed per tick (not dirty-subgraph re-embedding): the GNN
 forward is measured cheap at serving scale — a 3-layer forward over the
@@ -55,9 +61,11 @@ log = get_logger("gnn_streaming")
 _EdgeKey = tuple[str, str, int]   # (src_id, dst_id, kind) — store edge key
 
 
-@partial(jax.jit, static_argnames=("pk", "ek", "pi"))
+@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
+                                   "slices_sorted", "compute_dtype"))
 def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
-              pk: int, ek: int, pi: int):
+              pk: int, ek: int, pi: int, rel_offsets=None,
+              slices_sorted: bool = False, compute_dtype=None):
     """Apply the packed aux/edge deltas to the resident arrays, then run
     the full forward. One int32 transfer carries every delta (the tunnel
     charges per-transfer latency — see streaming._tick):
@@ -91,7 +99,10 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
     emask = emask.at[e_idx].set(e_mask, mode="drop")
 
     logits = gnn.forward(params, features, kind, nmask,
-                         esrc, edst, erel, emask, inc_nodes)
+                         esrc, edst, erel, emask, inc_nodes,
+                         rel_offsets=rel_offsets,
+                         slices_sorted=slices_sorted,
+                         compute_dtype=compute_dtype)
     probs = jax.nn.softmax(logits, axis=-1)
     # mask dead incident rows so a stale row can never surface a score
     probs = probs * inc_mask[:, None]
@@ -119,7 +130,28 @@ class GnnStreamingScorer(StreamingScorer):
         if mesh is not None:
             log.warning("gnn_streaming_mesh_unsupported")
             mesh = None
+        # kernel selection (set BEFORE super().__init__, which builds the
+        # mirror): the mirror layout is relation-bucketed either way —
+        # a valid COO for the reference kernel too — the flag only picks
+        # which kernel the tick runs
+        from ..config import get_settings
+        cfg = settings or get_settings()
+        self._use_bucketed = bool(getattr(cfg, "gnn_bucketed", True))
+        self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
         super().__init__(store, settings, mesh=mesh)
+
+    def _tick_statics(self, rel_offsets=None) -> dict:
+        """Static kwargs for _gnn_tick under the current mode. Slot reuse
+        under churn breaks within-slice dst order, so the mirror never
+        promises slices_sorted — the bucketed win here is the E-scaled
+        traffic, not the sorted scatter."""
+        offs = rel_offsets if rel_offsets is not None else self._rel_offsets
+        return {
+            "rel_offsets": offs if self._use_bucketed else None,
+            "slices_sorted": False,
+            "compute_dtype": self._compute_dtype if self._use_bucketed
+            else None,
+        }
 
     # -- mirror (re)initialisation ---------------------------------------
 
@@ -131,35 +163,68 @@ class GnnStreamingScorer(StreamingScorer):
         self._gnn_seq = self._synced_seq
         self._mirror_init()
 
+    def _mirror_offsets_now(self) -> tuple[int, ...]:
+        """The relation-region offsets a re-mirror of the CURRENT store
+        would derive — the single derivation shared by _mirror_init and
+        warm_growth, so the warm pre-compiles the shapes a rebuild will
+        actually land on."""
+        from ..graph.schema import RelationKind
+        from ..graph.snapshot import REL_SLICE_BUCKETS, rel_slice_offsets
+        counts = np.zeros(len(RelationKind), np.int64)
+        _, edges = self.store._raw()
+        for e in edges:
+            counts[int(e.kind)] += 2           # both directions
+        # 1/3 growth slack per region + a minimum slice per relation so
+        # first-edge churn of an unseen relation lands in a free pair
+        # instead of forcing an immediate re-mirror
+        return rel_slice_offsets(counts, slack=1 / 3,
+                                 min_cap=REL_SLICE_BUCKETS[0])
+
     def _mirror_init(self) -> None:
         """Rebuild the edge mirror + aux device arrays from the store,
         resolving rows through the base scorer's CURRENT id->row map
-        (NOT a fresh snapshot: rows must match the resident features)."""
-        from ..utils.padding import bucket_for
+        (NOT a fresh snapshot: rows must match the resident features).
+
+        Relation-bucketed layout (graph/snapshot.py contract, minus the
+        within-slice dst sort — slot reuse under churn destroys it
+        anyway): relation r owns slice [off[r], off[r+1]) of the edge
+        arrays, slots allocate in (fwd, rev) pairs from their OWN
+        region's free list, so the static offset table stays valid under
+        arbitrary churn; a region running out of pairs falls back to a
+        full re-mirror with re-derived capacities (counted in stats via
+        the journal-truncation/rebuild paths that also call this)."""
+        from ..graph.schema import RelationKind
+        offs = self._mirror_offsets_now()
+        num_rels = len(RelationKind)
+        pe = max(int(offs[-1]), 1)
         _, edges = self.store._raw()
-        need = max(int(np.ceil(2 * len(edges) * 4 / 3)), 1)
-        pe = bucket_for(need, self.settings.edge_bucket_sizes)
         esrc = np.zeros(pe, np.int32)
         edst = np.zeros(pe, np.int32)
         erel = np.full(pe, -1, np.int32)
         emask = np.zeros(pe, np.float32)
         self._edge_slot: dict[_EdgeKey, int] = {}
         self._node_edges: dict[str, set[_EdgeKey]] = {}
-        slot = 0
+        fill = [int(offs[r]) for r in range(num_rels)]
         for e in edges:
             srow = self._id_to_idx.get(e.src)
             drow = self._id_to_idx.get(e.dst)
             if srow is None or drow is None:   # placeholder outside base rows
                 continue
             key = (e.src, e.dst, int(e.kind))
+            r = int(e.kind)
+            slot = fill[r]
+            fill[r] += 2
             esrc[slot], edst[slot], emask[slot] = srow, drow, 1.0
             esrc[slot + 1], edst[slot + 1], emask[slot + 1] = drow, srow, 1.0
-            erel[slot] = erel[slot + 1] = int(e.kind)
+            erel[slot] = erel[slot + 1] = r
             self._edge_slot[key] = slot
             self._node_edges.setdefault(e.src, set()).add(key)
             self._node_edges.setdefault(e.dst, set()).add(key)
-            slot += 2
-        self._free_edge_slots: list[int] = list(range(pe - 2, slot - 2, -2))
+        self._rel_offsets: tuple[int, ...] = offs
+        # per-relation free pair lists (slot allocation stays region-local)
+        self._free_edge_slots: list[list[int]] = [
+            list(range(int(offs[r + 1]) - 2, fill[r] - 2, -2))
+            for r in range(num_rels)]
         self._esrc_dev = jnp.asarray(esrc)
         self._edst_dev = jnp.asarray(edst)
         self._erel_dev = jnp.asarray(erel)
@@ -180,10 +245,14 @@ class GnnStreamingScorer(StreamingScorer):
         drow = self._id_to_idx.get(dst)
         if srow is None or drow is None:
             return   # endpoint removed later in this batch: edge is gone too
-        if not self._free_edge_slots:
-            self._mirror_init()   # bucket overflow: full re-mirror (rare)
+        free = self._free_edge_slots[kind]
+        if not free:
+            # this relation's region overflowed: full re-mirror with
+            # re-derived capacities (the bucketed-layout fallback — the
+            # static offsets can't stretch in place)
+            self._mirror_init()
             return
-        slot = self._free_edge_slots.pop()
+        slot = free.pop()
         self._edge_slot[key] = slot
         self._node_edges.setdefault(src, set()).add(key)
         self._node_edges.setdefault(dst, set()).add(key)
@@ -193,14 +262,14 @@ class GnnStreamingScorer(StreamingScorer):
         slot = self._edge_slot.pop(key, None)
         if slot is None:
             return
-        src, dst, _ = key
+        src, dst, kind = key
         for nid in (src, dst):
             s = self._node_edges.get(nid)
             if s is not None:
                 s.discard(key)
                 if not s:
                     del self._node_edges[nid]
-        self._free_edge_slots.append(slot)
+        self._free_edge_slots[kind].append(slot)   # back to ITS region
         self._pending_edges[slot] = (0, 0, -1, 0)
 
     def _drain_edges(self) -> None:
@@ -285,7 +354,8 @@ class GnnStreamingScorer(StreamingScorer):
             self._params, self._features_dev, self._kind_dev,
             self._nmask_dev, self._esrc_dev, self._edst_dev,
             self._erel_dev, self._emask_dev, jnp.asarray(ints),
-            pk=pk, ek=ek, pi=self.snapshot.padded_incidents)
+            pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
+            **self._tick_statics())
         self._last_gnn = (logits, probs)
         return out
 
@@ -333,6 +403,7 @@ class GnnStreamingScorer(StreamingScorer):
             handles = (self._params, self._features_dev, self._kind_dev,
                        self._nmask_dev, self._esrc_dev, self._edst_dev,
                        self._erel_dev, self._emask_dev)
+            statics = self._tick_statics()
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
             inc_m = self.snapshot.incident_mask.astype(np.int32)
         for pk in delta_sizes:
@@ -347,31 +418,35 @@ class GnnStreamingScorer(StreamingScorer):
                     np.zeros(ek, np.int32),
                     inc_n, inc_m,
                 ]).astype(np.int32, copy=False)
-                _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek, pi=pi)
+                _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek, pi=pi,
+                          **statics)
 
     def warm_growth(self) -> None:
-        """Base growth shapes, then the GNN tick at every (pn, pe, pi) a
-        rebuild could land on — without this, a bucket-overflow rebuild
-        mid-serve pays a fresh _gnn_tick compile, the exact hiccup the
-        re-arm machinery exists to prevent (code-review r5). Post-rebuild
-        dispatches always use the smallest delta buckets (pending state is
-        reset by _init_from_store), so only those are warmed."""
+        """Base growth shapes, then the GNN tick at every (pn, offsets,
+        pi) a rebuild could land on — without this, a bucket-overflow
+        rebuild mid-serve pays a fresh _gnn_tick compile, the exact
+        hiccup the re-arm machinery exists to prevent (code-review r5).
+        Post-rebuild dispatches always use the smallest delta buckets
+        (pending state is reset by _init_from_store), so only those are
+        warmed. Edge shapes warm at the CURRENT offsets and at the
+        offsets a re-mirror of the current store would derive
+        (_mirror_offsets_now — the same derivation the rebuild runs);
+        per-relation next-bucket combos are deliberately not enumerated,
+        the combinatorics would swamp the warm budget for a rare single
+        compile."""
         super().warm_growth()
-        from ..utils.padding import bucket_for
         shapes = {(cpn, cpi) for cpn, cpi, _w, _pw, _d
                   in self._growth_shape_combos()}
         with self.serve_lock:
             dim = self.snapshot.features.shape[1]
-            pe = int(self._esrc_dev.shape[0])
-            pe_now = bucket_for(
-                max(int(np.ceil(2 * len(self.store._edges) * 4 / 3)), 1),
-                self.settings.edge_bucket_sizes)
-            next_pe = bucket_for(pe + 1, self.settings.edge_bucket_sizes)
+            offs_cur = self._rel_offsets
+            offs_now = self._mirror_offsets_now()
         pk = ek = _DELTA_BUCKETS[0]
         for cpn, cpi in shapes:
-            for cpe in {pe, pe_now, next_pe}:
+            for offs in {offs_cur, offs_now}:
                 if self._warm_stop:
                     return
+                cpe = max(int(offs[-1]), 1)
                 ints = np.concatenate([
                     np.full(pk, cpn, np.int32), np.zeros(pk, np.int32),
                     np.zeros(pk, np.int32),
@@ -388,7 +463,8 @@ class GnnStreamingScorer(StreamingScorer):
                           jnp.zeros(cpe, jnp.int32),
                           jnp.full((cpe,), -1, jnp.int32),
                           jnp.zeros(cpe, jnp.float32),
-                          jnp.asarray(ints), pk=pk, ek=ek, pi=cpi)
+                          jnp.asarray(ints), pk=pk, ek=ek, pi=cpi,
+                          **self._tick_statics(rel_offsets=offs))
 
     def warm_serving(self) -> None:
         super().warm_serving()

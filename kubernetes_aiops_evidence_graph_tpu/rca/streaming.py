@@ -121,7 +121,7 @@ def _graph_tick(mesh, nodes_per_shard: int, rows_per_shard: int,
     per tick (each [Pn/G, DIM] — fine on ICI, where the batch-path ring
     already proved out)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ..parallel.compat import shard_map
     from ..parallel.sharded_rules import ring_fold
     from .tpu_backend import finish_scores
 

@@ -30,7 +30,8 @@ class PodState:
     terminated_reason: Optional[str] = None
     not_ready_seconds: float = 0.0
     readiness_probe_failing: bool = False
-    started_at: Optional[datetime] = None
+    started_at: Optional[datetime] = None       # status.startTime
+    creation_ts: Optional[datetime] = None      # metadata.creationTimestamp
     # review-surface detail (reference kubernetes_collector.py:194-267):
     # populated from the wire by the live backend; None on the fake
     # cluster, where the collector synthesizes a one-container view from

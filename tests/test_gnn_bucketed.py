@@ -1,0 +1,142 @@
+"""Relation-bucketed GNN kernel — parity against the reference mapping.
+
+The bucketed kernel (ops.gather_matmul_segment driven by the snapshot's
+(rel, dst) layout) must produce the same logits AND gradients as the
+transform-then-gather reference on the same snapshot: the two are
+algebraically identical (sum_e W_{rel_e} h_src regrouped by relation), so
+any drift is a layout/indexing bug, not float noise. CPU f32 reassociates
+identically here in practice, but the pinned tolerance is the ISSUE's
+1e-4 contract.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import build_snapshot
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+
+from tests.test_streaming import _world, SMALL
+
+
+@pytest.fixture(scope="module")
+def world_batch():
+    _, builder, _ = _world(num_pods=120)
+    snap = build_snapshot(builder.store, SMALL)
+    params = gnn.init_params(jax.random.PRNGKey(3), hidden=32, layers=3)
+    return params, gnn.snapshot_batch(snap), snap
+
+
+def test_forward_parity_bucketed_vs_reference(world_batch):
+    params, b, snap = world_batch
+    assert b["rel_offsets"], "snapshot should carry the bucketed layout"
+    l_ref = np.asarray(gnn.forward_batch(params, b, bucketed=False))
+    l_buck = np.asarray(gnn.forward_batch(params, b))
+    np.testing.assert_allclose(l_buck, l_ref, rtol=1e-4, atol=1e-4)
+
+
+def test_grad_parity_bucketed_vs_reference(world_batch):
+    params, b, _ = world_batch
+
+    def loss(p, offs, ss):
+        return gnn.loss_fn(
+            p, b["features"], b["node_kind"], b["node_mask"],
+            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
+            b["incident_nodes"], b["labels"], b["label_mask"],
+            rel_offsets=offs, slices_sorted=ss)
+
+    g_ref = jax.grad(lambda p: loss(p, None, False))(params)
+    g_buck = jax.grad(lambda p: loss(p, b["rel_offsets"], True))(params)
+    for a, c in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_buck)):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_compute_path_close_and_distinct(world_batch):
+    """bf16 matmul operands with f32 accumulation: close to f32 (loose
+    tolerance — one bf16 rounding per product term) and top-1 stable on
+    this world."""
+    params, b, _ = world_batch
+    l_f32 = np.asarray(gnn.forward_batch(params, b))
+    l_bf16 = np.asarray(gnn.forward_batch(params, b,
+                                          compute_dtype="bfloat16"))
+    assert l_bf16.dtype == np.float32   # accumulation/output stay f32
+    np.testing.assert_allclose(l_bf16, l_f32, rtol=0.05, atol=0.05)
+    live = np.asarray(b["label_mask"]) > 0
+    assert (l_bf16[live].argmax(-1) == l_f32[live].argmax(-1)).all()
+
+
+def test_train_step_through_bucketed_kernel(world_batch):
+    """make_train_step with static rel_offsets trains (loss decreases)
+    and tracks the reference step's loss trajectory."""
+    import optax
+    params, b, _ = world_batch
+    batch = {k: v for k, v in b.items() if k != "rel_offsets"}
+    tx = optax.adam(1e-2)
+    step = gnn.make_train_step(tx)
+
+    p_ref, p_buck = params, params
+    s_ref, s_buck = tx.init(params), tx.init(params)
+    for _ in range(5):
+        p_ref, s_ref, l_ref = step(p_ref, s_ref, batch)
+        p_buck, s_buck, l_buck = step(
+            p_buck, s_buck, batch, rel_offsets=b["rel_offsets"],
+            slices_sorted=True)
+        assert abs(float(l_ref) - float(l_buck)) < 1e-4
+    assert float(l_buck) < float(
+        gnn.loss_fn(params, batch["features"], batch["node_kind"],
+                    batch["node_mask"], batch["edge_src"],
+                    batch["edge_dst"], batch["edge_rel"],
+                    batch["edge_mask"], batch["incident_nodes"],
+                    batch["labels"], batch["label_mask"]))
+
+
+def test_backend_flag_selects_reference(world_batch, monkeypatch):
+    """settings.gnn_bucketed=False is the escape hatch: the backend must
+    score through the reference kernel and still match."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import GnnRcaBackend
+    params, _, snap = world_batch
+    on = GnnRcaBackend(params=params,
+                       settings=load_settings(gnn_bucketed=True))
+    off = GnnRcaBackend(params=params,
+                        settings=load_settings(gnn_bucketed=False))
+    assert on._bucketed and not off._bucketed
+    r_on = on.score_snapshot(snap)
+    r_off = off.score_snapshot(snap)
+    np.testing.assert_allclose(r_on["probs"], r_off["probs"],
+                               rtol=1e-4, atol=1e-5)
+    assert (r_on["top_rule_index"] == r_off["top_rule_index"]).all()
+
+
+def test_zero_width_slices_and_empty_graph():
+    """Relations with no edges get zero-width slices the kernel skips;
+    a store with nodes but no edges still scores."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.snapshot import (
+        rel_slice_offsets)
+    offs = rel_slice_offsets([0, 5, 0, 128, 0, 0, 0, 0, 0])
+    assert offs[1] - offs[0] == 0 and offs[3] - offs[2] == 0
+    assert offs[2] - offs[1] == 64 and offs[4] - offs[3] == 128
+
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphEntity
+    builder = GraphBuilder()
+    builder.store.upsert_entities([
+        GraphEntity(id="incident:lonely", type="Incident", properties={}),
+        GraphEntity(id="pod:ns:a", type="Pod", properties={})])
+    snap = build_snapshot(builder.store, SMALL)
+    assert snap.num_edges == 0
+    params = gnn.init_params(jax.random.PRNGKey(0), hidden=16, layers=2)
+    logits = np.asarray(gnn.forward_batch(params, gnn.snapshot_batch(snap)))
+    assert np.isfinite(logits).all()
+
+
+def test_stepped_ladder_offsets():
+    """Above the power-of-two rungs, capacities step by 8192 — bounded
+    padding (≤ ~6% at bench scale) AND a discrete jit-key set."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.snapshot import (
+        rel_slice_offsets)
+    offs = rel_slice_offsets([8193, 70000])
+    assert offs[1] == 16384            # next 8192-multiple above 8193
+    assert offs[2] - offs[1] == 73728  # 9 * 8192

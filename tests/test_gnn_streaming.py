@@ -204,6 +204,76 @@ def test_overflow_remirror_sentinel_tracks_new_pe(params, monkeypatch):
         "padding sentinel must be out of range of the NEW edge arrays"
 
 
+def _assert_bucketed_layout_valid(scorer):
+    """Every live mirror slot must sit inside its relation's static
+    region, and the device arrays must agree with the host maps — the
+    invariant that makes the static rel_offsets a safe jit key."""
+    offs = scorer._rel_offsets
+    erel = np.asarray(scorer._erel_dev)
+    emask = np.asarray(scorer._emask_dev)
+    assert int(offs[-1]) == erel.shape[0]
+    for (_, _, kind), slot in scorer._edge_slot.items():
+        assert offs[kind] <= slot < slot + 1 < offs[kind + 1], \
+            f"slot pair {slot} escaped region {kind}"
+    live = emask > 0
+    for r in range(len(offs) - 1):
+        sl = slice(int(offs[r]), int(offs[r + 1]))
+        assert (erel[sl][live[sl]] == r).all(), f"region {r} polluted"
+
+
+def test_mirror_bucketed_layout_survives_churn(params, frozen_now):
+    """The relation-bucketed mirror layout must stay valid under full-mix
+    churn (slots recycle within their region) while scoring parity with a
+    cold re-embed holds."""
+    cluster, builder, _ = _world(num_pods=120)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    assert scorer._use_bucketed
+    _assert_bucketed_layout_valid(scorer)
+    scorer.rescore()
+    _churn(cluster, builder, scorer, 400, seed=21)
+    scorer.dispatch()
+    _assert_bucketed_layout_valid(scorer)
+    mine = scorer.rescore()
+    cold, _ = _cold_raw(builder.store, SMALL, params)
+    _assert_parity(mine, cold)
+
+
+def test_mirror_region_overflow_falls_back_to_remirror(params):
+    """Exhausting ONE relation's region must trigger a full re-mirror
+    with re-derived capacities (the static offsets can't stretch in
+    place) — and the new layout must be valid and complete."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.schema import RelationKind
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphRelation
+
+    _, builder, _ = _world(num_pods=60)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    scorer.rescore()
+    kind = int(RelationKind.CALLS)
+    offs_before = scorer._rel_offsets
+    cap = offs_before[kind + 1] - offs_before[kind]
+    svcs = sorted(n for n in scorer._id_to_idx if n.startswith("service:"))
+    pods = sorted(n for n in scorer._id_to_idx if n.startswith("pod:"))
+    rels = [GraphRelation(source_id=s, target_id=p, relation_type="CALLS")
+            for s in svcs for p in pods][:cap]   # cap pairs > cap slots
+    assert len(rels) * 2 > cap, "world too small to overflow the region"
+    builder.store.upsert_relations(rels)
+    scorer.dispatch()   # drains the journal -> region overflow -> re-mirror
+    offs_after = scorer._rel_offsets
+    assert offs_after[kind + 1] - offs_after[kind] > cap, \
+        "re-mirror should have grown the overflowed region"
+    _assert_bucketed_layout_valid(scorer)
+    # the mirror still tracks the store exactly after the fallback
+    _, edges = builder.store._raw()
+    want = set()
+    for e in edges:
+        s, d = scorer._id_to_idx.get(e.src), scorer._id_to_idx.get(e.dst)
+        if s is not None and d is not None:
+            want.add((s, d))
+            want.add((d, s))
+    scorer.dispatch()
+    assert scorer.mirror_edge_rows() == want
+
+
 def test_warm_paths_compile_without_touching_state(params):
     """warm_gnn / warm_growth are read-only: resident handles and scores
     must be unchanged after a full warm sweep (they pre-compile only)."""

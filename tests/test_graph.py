@@ -122,8 +122,8 @@ def test_snapshot_tensorization():
 
     src, dst = snap.typed_edges(RelationKind.AFFECTS)
     assert len(src) == 2  # both directions
-    # padded tail is masked
-    assert snap.edge_rel[snap.num_edges:].max() == -1
+    # padding (slice tails of the relation-bucketed layout) is masked
+    assert snap.edge_rel[snap.edge_mask == 0].max() == -1
 
 
 def test_builder_ingest_applies_evidence():
@@ -282,24 +282,35 @@ def test_cleanup_500_incidents_is_fast_at_scale():
     assert dt < 2.0, f"cleanup took {dt:.2f}s — removal is not O(degree)"
 
 
-def test_snapshot_edges_sorted_by_dst_including_padding():
-    """build_snapshot's dst-sort contract: the ENTIRE edge_dst array is
-    non-decreasing (live prefix sorted, padding pinned to the last node
-    row), because gnn_backend keys the segment-sum sorted fast path off
-    gnn.edges_sorted_by_dst — breaking the sort would silently fall back
-    to the 1.9x-slower scatter, not fail."""
+def test_snapshot_edges_sorted_by_rel_dst_including_padding():
+    """build_snapshot's (rel, dst) sort contract — the relation-bucketed
+    layout the GNN's bucketed kernel slices statically (successor of the
+    old global dst-sort pin): relation r owns exactly
+    [rel_offsets[r], rel_offsets[r+1]), its live prefix is dst-sorted
+    (per-slice sorted segment-sum fast path — breaking it would silently
+    fall back to the 1.9x-slower scatter, not fail), and slice padding is
+    mask-0 / rel -1 / dst pinned to the last node row so each slice stays
+    non-decreasing through its tail."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.schema import RelationKind
     from kubernetes_aiops_evidence_graph_tpu.rca import gnn
 
     snap = build_snapshot(_mini_store(), SMALL)
     assert snap.num_edges > 0
+    offs = snap.rel_offsets
+    assert len(offs) == len(RelationKind) + 1
+    assert offs[0] == 0 and offs[-1] == snap.padded_edges
+    assert all(a <= b for a, b in zip(offs, offs[1:]))
     d = snap.edge_dst
-    assert (d[1:] >= d[:-1]).all(), "edge_dst not globally non-decreasing"
-    assert gnn.edges_sorted_by_dst(d)
-    # padding rows target the last node row with zero mask
-    pad = snap.edge_mask == 0
-    if pad.any():
-        assert (d[pad] == snap.padded_nodes - 1).all()
-        assert (snap.edge_rel[pad] == -1).all()
-    # and the sort didn't drop or duplicate live edges
-    live = snap.edge_mask > 0
-    assert int(live.sum()) == snap.num_edges
+    for r in range(len(RelationKind)):
+        lo, hi = offs[r], offs[r + 1]
+        sl = slice(lo, hi)
+        # every slice non-decreasing in dst, INCLUDING its padded tail
+        assert (d[lo + 1:hi] >= d[lo:hi - 1]).all(), f"slice {r} unsorted"
+        live = snap.edge_mask[sl] > 0
+        # live prefix carries exactly this relation; padding is -1
+        assert (snap.edge_rel[sl][live] == r).all()
+        assert (snap.edge_rel[sl][~live] == -1).all()
+        assert (d[sl][~live] == snap.padded_nodes - 1).all()
+    assert gnn.slices_sorted_by_dst(d, offs)
+    # and the layout didn't drop or duplicate live edges
+    assert int((snap.edge_mask > 0).sum()) == snap.num_edges

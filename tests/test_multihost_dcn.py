@@ -34,7 +34,7 @@ assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "graph": 2}
 # cross-host collective: psum over dp must see every host's contribution
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from kubernetes_aiops_evidence_graph_tpu.parallel.compat import shard_map
 
 pid = jax.process_index()
 

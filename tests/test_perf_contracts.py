@@ -1,0 +1,148 @@
+"""Perf contracts: device_metrics' bytes/FLOP models vs the shapes jax
+actually traces (marker: perf_contract).
+
+The roofline records in BENCH are only as honest as
+`device_metrics.gnn_layer_accounting`. These gates walk the jaxpr of one
+message-passing layer — no execution, CPU-cheap at any shape — and check
+that the analytic model's matmul FLOPs and gather/scatter row counts
+equal what the traced program actually contains. A future PR that
+changes the kernel without updating the cost model (or vice versa) fails
+here instead of silently shipping a wrong roofline %.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+
+try:                                    # newer jax
+    from jax.extend.core import ClosedJaxpr as _ClosedJaxpr
+except ImportError:                     # jax 0.4.x
+    from jax.core import ClosedJaxpr as _ClosedJaxpr
+
+PN, H = 512, 32
+
+
+def _dot_flops(eqn) -> int:
+    """2*B*M*N*K for one dot_general from its operand shapes."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = (v.aval.shape for v in eqn.invars)
+    k = int(np.prod([lhs[i] for i in lc])) if lc else 1
+    b = int(np.prod([lhs[i] for i in lb])) if lb else 1
+    m = int(np.prod([d for i, d in enumerate(lhs)
+                     if i not in lc and i not in lb]))
+    n = int(np.prod([d for i, d in enumerate(rhs)
+                     if i not in rc and i not in rb]))
+    return 2 * b * m * n * k
+
+
+def _trace_stats(jaxpr) -> dict:
+    """Sum dot FLOPs and gather/scatter ROW counts over a closed jaxpr."""
+    stats = {"dot_flops": 0, "gather_rows": 0, "scatter_rows": 0}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == "dot_general":
+                stats["dot_flops"] += _dot_flops(eqn)
+            elif name == "gather":
+                shape = eqn.outvars[0].aval.shape
+                if len(shape) == 2 and shape[1] == H:   # row gathers only
+                    stats["gather_rows"] += shape[0]
+            elif name in ("scatter-add", "scatter_add"):
+                shape = eqn.invars[2].aval.shape        # updates operand
+                if len(shape) == 2 and shape[1] == H:
+                    stats["scatter_rows"] += shape[0]
+            for sub in eqn.params.values():
+                if isinstance(sub, _ClosedJaxpr):
+                    walk(sub.jaxpr)
+    walk(jaxpr.jaxpr)
+    return stats
+
+
+def _layer_args(offsets):
+    e = int(offsets[-1])
+    layer = {
+        "w_self": jnp.zeros((H, H)),
+        "w_rel": jnp.zeros((gnn.NUM_RELS, H, H)),
+        "b": jnp.zeros((H,)),
+    }
+    return (jnp.zeros((PN, H)), layer, jnp.zeros(e, jnp.int32),
+            jnp.zeros(e, jnp.int32), jnp.zeros(e, jnp.int32),
+            jnp.zeros(e), jnp.zeros(PN))
+
+
+@pytest.mark.perf_contract
+def test_bucketed_layer_model_matches_trace():
+    offsets = (0, 64, 192, 192, 448)   # uneven slices incl. a zero-width
+    e = offsets[-1]
+    h_t, layer, src, dst, _rel, mask, inv = _layer_args(offsets)
+
+    def f(h, w_rel, w_self, b):
+        lyr = {"w_rel": w_rel, "w_self": w_self, "b": b}
+        return gnn._message_pass_bucketed(h, lyr, src, dst, mask, offsets,
+                                          inv, True, None)
+
+    stats = _trace_stats(jax.make_jaxpr(f)(
+        h_t, layer["w_rel"], layer["w_self"], layer["b"]))
+    acct = dm.gnn_layer_accounting(PN, e, H, bucketed=True)
+
+    model_dot = 2 * e * H * H + 2 * PN * H * H
+    assert stats["dot_flops"] == model_dot, (stats, model_dot)
+    assert stats["gather_rows"] == e
+    assert stats["scatter_rows"] == e
+    # the model's edge traffic terms must count the SAME rows the trace
+    # gathers/scatters (e*H each way at 4 bytes in the f32 model)
+    assert acct["flops"] >= model_dot
+    assert acct["reads"] >= stats["gather_rows"] * H * 4
+    assert acct["writes"] >= stats["scatter_rows"] * H * 4
+
+
+@pytest.mark.perf_contract
+def test_reference_layer_model_matches_trace():
+    offsets = (0, 448)   # layout irrelevant to the reference kernel
+    e = offsets[-1]
+    h_t, layer, src, dst, rel, mask, inv = _layer_args(offsets)
+
+    def f(h, w_rel, w_self, b):
+        lyr = {"w_rel": w_rel, "w_self": w_self, "b": b}
+        return gnn._message_pass(h, lyr, src, dst, rel, mask, inv,
+                                 sorted_by_dst=True)
+
+    stats = _trace_stats(jax.make_jaxpr(f)(
+        h_t, layer["w_rel"], layer["w_self"], layer["b"]))
+    model_dot = 2 * PN * gnn.NUM_RELS * H * H + 2 * PN * H * H
+    assert stats["dot_flops"] == model_dot, (stats, model_dot)
+    assert stats["gather_rows"] == e
+    assert stats["scatter_rows"] == e
+    acct = dm.gnn_layer_accounting(PN, e, H)
+    assert acct["flops"] >= model_dot
+    # the dense [Pn, R, H] materialization must stay in the reference
+    # model's write term — losing it would overstate the roofline %
+    assert acct["writes"] >= PN * gnn.NUM_RELS * H * 4
+
+
+@pytest.mark.perf_contract
+def test_bucketed_model_has_no_dense_rel_term():
+    """The bucketed model's traffic must scale with E, never Pn*R: its
+    marginal cost in Pn carries no [Pn, R, H] term, and at the bench
+    shapes (reference e=524288 on the old global bucket, bucketed
+    e=287488 on the stepped ladder) the model floor drops."""
+    pn, h = 65536, 64
+    buck = dm.gnn_layer_accounting(pn, 287488, h, bucketed=True)
+    ref = dm.gnn_layer_accounting(pn, 524288, h)
+    assert buck["bytes"] < ref["bytes"]
+    # marginal Pn cost: doubling Pn must NOT add a dense pn*R*h*4 copy
+    buck2 = dm.gnn_layer_accounting(2 * pn, 287488, h, bucketed=True)
+    dense_copy_growth = pn * gnn.NUM_RELS * h * 4
+    assert buck2["bytes"] - buck["bytes"] < dense_copy_growth / 2
+    ref2 = dm.gnn_layer_accounting(2 * pn, 524288, h)
+    assert ref2["bytes"] - ref["bytes"] > dense_copy_growth  # and ref does
+    # bf16 compute path: operand traffic shrinks, FLOPs unchanged
+    bf16 = dm.gnn_layer_accounting(pn, 287488, h, bucketed=True,
+                                   compute_bytes=2)
+    assert bf16["bytes"] < buck["bytes"]
+    assert bf16["flops"] == buck["flops"]
