@@ -1,0 +1,57 @@
+"""graft-audit — static analysis that pins the TPU hot-path invariants.
+
+PR 1 bought its speedup by structural invariants (no 2-D scatters in the
+GNN hot path, no dense [N, R, H] materialization, static (rel, dst)-sorted
+slice layouts, bf16-operand/f32-accum dtype discipline) that nothing
+guarded: one careless edit to rca/gnn.py or parallel/sharded_gnn.py would
+silently reintroduce the 41 ms/forward regression. This subsystem encodes
+those properties as machine-checkable analysis instead of tribal
+knowledge — the reference system's core value is *auditability* of
+automated decisions (PAPERS.md), and that has to include our own compute
+graph.
+
+Three passes:
+
+* **Pass 1 — jaxpr audit** (`jaxpr_audit`, `registry`, `invariants`):
+  every hot-path entrypoint (bucketed + reference GNN forward, both
+  sharded halo strategies, the streaming ticks, ops kernels, the rules
+  scoring kernel, the train step) is traced with canonical bench shapes
+  and its jaxpr walked against a declarative invariant spec — forbidden
+  primitives, no 2-D scatter, no f64, a per-intermediate byte budget that
+  rejects [N, R, H]-scale materialization, bf16→f32 accumulation on the
+  matmul paths, and the sorted-scatter contract.
+* **Pass 2 — AST lint** (`ast_lint`): repo-specific source rules —
+  tracer branches and np./wall-clock calls inside jitted code, implicit
+  host syncs in the hot modules, broad excepts, and jit static/donate
+  signature completeness — with an inline ``# graft-audit: allow[rule]``
+  waiver pragma so intentional sites are explicit and counted.
+* **Pass 3 — runtime guards** (`runtime_guards`): pytest-side transfer
+  guards + a compilation counter for recompilation-hazard detection on
+  the streaming-churn workload (see tests/test_graft_audit.py).
+
+CLI: ``python -m kubernetes_aiops_evidence_graph_tpu.analysis --report
+json`` exits non-zero on violations. This package must stay import-light
+(no jax at import time) — pass 1 pulls jax lazily.
+"""
+from __future__ import annotations
+
+from .findings import Finding, Report
+
+__all__ = ["Finding", "Report", "run_audit"]
+
+
+def run_audit(root=None, jaxpr: bool = True, ast: bool = True) -> Report:
+    """Run the static passes and return a combined Report.
+
+    ``root`` overrides the source tree for the AST pass (fixture trees in
+    tests); the jaxpr pass always audits the installed package's
+    registered entrypoints.
+    """
+    report = Report()
+    if jaxpr:
+        from .jaxpr_audit import audit_registered_entrypoints
+        report.extend(audit_registered_entrypoints())
+    if ast:
+        from .ast_lint import lint_tree
+        report.extend(lint_tree(root))
+    return report

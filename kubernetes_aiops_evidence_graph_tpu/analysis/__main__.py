@@ -1,0 +1,76 @@
+"""graft-audit CLI.
+
+    python -m kubernetes_aiops_evidence_graph_tpu.analysis [--report json]
+
+Exit status 0 = zero unwaived violations; 1 = violations found. The
+jaxpr pass traces the registered hot-path entrypoints (including both
+sharded halo strategies, which need a multi-device mesh — a virtual
+8-device CPU mesh is forced below when jax is not yet imported); the AST
+pass lints the package source (or ``--root`` for fixture trees).
+
+``--jaxpr-fixture dotted.module`` audits a module exposing an
+``ENTRYPOINTS`` tuple instead of the built-in registry — how the
+seeded-violation fixtures under tests/fixtures/audit are driven.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+
+
+def _force_virtual_mesh() -> None:
+    """8 virtual CPU devices so the sharded entrypoints trace hermetically
+    (same discipline as tests/conftest.py). Must run before jax import."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graft-audit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--report", choices=("text", "json"), default="text")
+    ap.add_argument("--root", default=None,
+                    help="lint this tree instead of the installed package "
+                         "(fixture mode; skips the jaxpr pass unless "
+                         "--jaxpr-fixture is also given)")
+    ap.add_argument("--jaxpr-fixture", default=None,
+                    help="dotted module exposing ENTRYPOINTS to audit "
+                         "instead of the built-in registry")
+    ap.add_argument("--skip-jaxpr", action="store_true")
+    ap.add_argument("--skip-ast", action="store_true")
+    args = ap.parse_args(argv)
+
+    from .findings import Report
+    report = Report()
+
+    run_jaxpr = not args.skip_jaxpr and (args.root is None
+                                         or args.jaxpr_fixture)
+    if run_jaxpr:
+        _force_virtual_mesh()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from .jaxpr_audit import audit_entrypoints
+        if args.jaxpr_fixture:
+            mod = importlib.import_module(args.jaxpr_fixture)
+            report.extend(audit_entrypoints(mod.ENTRYPOINTS))
+        else:
+            from .registry import ENTRYPOINTS
+            report.extend(audit_entrypoints(ENTRYPOINTS))
+    if not args.skip_ast:
+        from .ast_lint import lint_tree
+        report.extend(lint_tree(args.root))
+
+    print(report.to_json() if args.report == "json" else report.to_text())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

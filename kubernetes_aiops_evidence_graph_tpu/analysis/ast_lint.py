@@ -1,0 +1,445 @@
+"""Pass 2 — repo-specific AST lint over the package source (stdlib-only).
+
+Rules (ids are what the waiver pragma names):
+
+* ``tracer-branch``   — Python ``if``/``while`` on a non-static parameter
+  inside jitted/traced code: the branch freezes one trace, silently
+  specializing the kernel (or crashing with a ConcretizationError on
+  device). ``x is None`` tests and shape/dtype attribute tests are static
+  and exempt.
+* ``np-in-traced``    — ``np.*`` calls inside jitted/traced code run on
+  host per trace, constant-folding device data out of the jaxpr.
+* ``wall-clock``      — ``time.time()`` anywhere: NTP steps make it
+  non-monotonic; durations must use monotonic()/perf_counter(). Epoch
+  timestamps for export are waivable.
+* ``host-sync``       — implicit device→host syncs in the hot modules
+  (rca/, ops/, parallel/): ``float()``/``int()``/``np.asarray()``/
+  ``.item()``/``.tolist()`` applied to device values. Explicit
+  ``jax.device_get`` is the sanctioned transfer and exempts the
+  expression.
+* ``broad-except``    — ``except Exception``/bare except that swallows
+  (handlers that re-raise are exempt). Intentional isolation boundaries
+  carry a waiver with the reason.
+* ``missing-static``  — an ``int``/``bool``-annotated parameter of a
+  jitted function not listed in static_argnames: it would be traced and
+  either retrace per value or break Python-side use.
+* ``jit-undeclared``/``jit-signature`` — every jit site in the hot
+  modules must be declared in :data:`JIT_DECLARATIONS` with its exact
+  static_argnames and donate_argnums (completeness: a new jitted kernel
+  must register its signature — and its jaxpr entrypoint — to land).
+
+Waiver pragma: ``# graft-audit: allow[rule] reason`` on the offending
+line or the line above. Waived sites are counted and reported, never
+silently dropped.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .findings import Finding, Report
+
+HOT_DIRS = {"rca", "ops", "parallel"}
+
+# functions that run under trace without their own jit decoration (called
+# from jitted entrypoints in the hot modules) — tracer-branch and
+# np-in-traced apply inside them too
+TRACED_EXTRA = {
+    "forward", "loss_fn", "rel_messages", "_message_pass",
+    "_message_pass_bucketed", "gather_matmul_segment", "scatter_add",
+    "scatter_max", "scatter_add_2d", "gather_neighbors", "_aggregate",
+    "finish_scores", "pair_contract", "_ring_messages", "_ring_readout",
+    "local_loss", "local_score", "local_tick",
+}
+
+# calls that produce device values (for the host-sync dataflow)
+DEVICE_RETURNING = {
+    "forward_batch", "gather_matmul_segment", "k_hop_reach",
+    "propagate_labels", "segment_sum", "scatter_add", "scatter_max",
+}
+# explicit-transfer calls: an expression containing one is sanctioned
+SAFE_TRANSFER = {"jax.device_get", "jax.device_put", "jax.block_until_ready"}
+# jax.* calls that return host objects, not device arrays
+NON_ARRAY_JAX = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_index", "jax.process_count",
+    "jax.default_backend", "jax.tree_util.tree_structure",
+}
+HOST_SINKS = {"float", "int", "bool"}
+NP_SINKS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+SYNC_METHODS = {"item", "tolist"}
+
+# (posix path relative to the package root, function name) -> (expected
+# static_argnames, expected donate_argnums). EVERY jit site under a hot
+# dir must appear here — jaxpr-audit registration rides along (see
+# registry.py module docstring).
+JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]] = {
+    ("rca/gnn.py", "step"): (("rel_offsets", "slices_sorted"), (0, 1)),
+    ("rca/gnn.py", "forward"): (
+        ("sorted_by_dst", "rel_offsets", "slices_sorted", "compute_dtype"),
+        ()),
+    ("rca/gnn_streaming.py", "_gnn_tick"): (
+        ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype"),
+        ()),
+    ("rca/streaming.py", "_tick"): (
+        ("padded_incidents", "pair_width", "pk", "rk", "width"), ()),
+    ("rca/streaming.py", "tick"): ((), ()),
+    ("rca/tpu_backend.py", "_score_device"): (
+        ("padded_incidents", "pair_width"), ()),
+    ("rca/device_metrics.py", "_scan_stream"): (("k",), ()),
+    ("rca/device_metrics.py", "_scan_matmul"): (("k",), ()),
+    ("rca/device_metrics.py", "<lambda>"): ((), ()),
+    ("rca/device_metrics.py", "_loop_score"): (
+        ("padded_incidents", "pair_width"), ()),
+    ("rca/device_metrics.py", "scan_fwd"): (
+        ("k", "sorted_", "offs", "ss", "cd"), ()),
+    ("ops/propagate.py", "k_hop_reach"): (("num_nodes", "hops"), ()),
+    ("ops/propagate.py", "propagate_labels"): (
+        ("num_nodes", "iterations"), ()),
+    ("parallel/sharded_gnn.py", "step"): ((), (0, 1)),
+    ("parallel/sharded_rules.py", "sharded"): ((), ()),
+}
+
+_WAIVER_RE = re.compile(
+    r"#\s*graft-audit:\s*allow\[([a-zA-Z0-9_,\- ]+)\]\s*(.*)")
+
+
+def _dotted(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    return _dotted(call.func)
+
+
+def _is_device_call(name: str) -> bool:
+    if not name:
+        return False
+    if name in SAFE_TRANSFER or name in NON_ARRAY_JAX:
+        return False
+    if name.startswith("jnp.") or name.startswith("jax."):
+        return True
+    return name.rsplit(".", 1)[-1] in DEVICE_RETURNING
+
+
+def _expr_transfer_kind(expr, device_names: set[str]) -> str:
+    """'safe' (contains an explicit transfer), 'device', or 'host'."""
+    has_device = False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            name = _call_name(n)
+            if name in SAFE_TRANSFER:
+                return "safe"
+            if _is_device_call(name):
+                has_device = True
+        elif isinstance(n, ast.Name) and n.id in device_names:
+            has_device = True
+    return "device" if has_device else "host"
+
+
+def _static_argnames_from_call(call: ast.Call) -> tuple[set[str], tuple[int, ...]]:
+    statics: set[str] = set()
+    donate: tuple[int, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                statics.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                statics.update(e.value for e in v.elts
+                               if isinstance(e, ast.Constant))
+        elif kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                donate = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                donate = tuple(e.value for e in v.elts
+                               if isinstance(e, ast.Constant))
+    return statics, donate
+
+
+def _jit_decoration(fn: ast.FunctionDef):
+    """(statics, donate) if fn is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        name = _dotted(dec) if not isinstance(dec, ast.Call) \
+            else _call_name(dec)
+        if isinstance(dec, ast.Call):
+            if name in ("jax.jit", "jit"):
+                return _static_argnames_from_call(dec)
+            if name in ("partial", "functools.partial") and dec.args:
+                inner = _dotted(dec.args[0])
+                if inner in ("jax.jit", "jit"):
+                    return _static_argnames_from_call(dec)
+        elif name in ("jax.jit", "jit"):
+            return set(), ()
+    return None
+
+
+class _FileLint:
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path, self.rel, self.source = path, rel, source
+        self.tree = ast.parse(source)
+        self.findings: list[Finding] = []
+        self.in_hot = bool(set(Path(rel).parts[:-1]) & HOT_DIRS)
+        self.waivers: dict[int, tuple[set[str], str]] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _WAIVER_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                self.waivers[i] = (rules, m.group(2).strip())
+        # jit call-form targets in this module: jax.jit(fn_name, ...)
+        self.call_form_jits: dict[str, tuple[set[str], tuple[int, ...], int]] = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call) and _call_name(n) in ("jax.jit", "jit"):
+                statics, donate = _static_argnames_from_call(n)
+                if n.args and isinstance(n.args[0], ast.Name):
+                    self.call_form_jits[n.args[0].id] = (statics, donate,
+                                                         n.lineno)
+                elif n.args and isinstance(n.args[0], ast.Lambda):
+                    self.call_form_jits["<lambda>"] = (statics, donate,
+                                                       n.lineno)
+
+    def hit(self, rule: str, line: int, message: str) -> None:
+        waived, reason = False, ""
+        for ln in (line, line - 1):
+            w = self.waivers.get(ln)
+            if w and (rule in w[0] or "all" in w[0]):
+                waived, reason = True, w[1]
+                break
+        self.findings.append(Finding(
+            rule=rule, where=f"{self.rel}:{line}", message=message,
+            pass_name="ast", waived=waived, waiver_reason=reason))
+
+    # -- rules -----------------------------------------------------------
+
+    def lint(self, check_jit_declarations: bool) -> list[Finding]:
+        self._broad_except()
+        self._wall_clock()
+        traced = self._traced_functions()
+        for fn, statics in traced:
+            self._tracer_branch(fn, statics)
+            self._np_in_traced(fn)
+        if self.in_hot:
+            self._host_sync()
+            self._missing_static(traced)
+            if check_jit_declarations:
+                self._jit_declarations()
+        return self.findings
+
+    def _broad_except(self) -> None:
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            t = n.type
+            broad = t is None or (isinstance(t, ast.Name)
+                                  and t.id in ("Exception", "BaseException"))
+            if not broad:
+                continue
+            if any(isinstance(b, ast.Raise) for b in ast.walk(n)):
+                continue   # catch-and-rethrow is instrumentation, not swallowing
+            self.hit("broad-except", n.lineno,
+                     "broad except swallows all errors; narrow the catch "
+                     "or waive with the isolation reason")
+
+    def _wall_clock(self) -> None:
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call) and _call_name(n) == "time.time":
+                self.hit("wall-clock", n.lineno,
+                         "time.time() is not monotonic under NTP steps; "
+                         "use time.monotonic()/perf_counter() for durations")
+
+    def _traced_functions(self):
+        out = []
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.FunctionDef):
+                continue
+            dec = _jit_decoration(n)
+            if dec is not None:
+                out.append((n, dec[0]))
+            elif n.name in self.call_form_jits:
+                out.append((n, self.call_form_jits[n.name][0]))
+            elif self.in_hot and n.name in TRACED_EXTRA:
+                # statics by convention: int/bool-annotated params
+                out.append((n, self._annotated_static_params(n)))
+        return out
+
+    @staticmethod
+    def _annotated_static_params(fn: ast.FunctionDef) -> set[str]:
+        statics = set()
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in ("int", "bool", "str"):
+                statics.add(a.arg)
+        return statics
+
+    def _tracer_branch(self, fn: ast.FunctionDef, statics: set[str]) -> None:
+        params = {a.arg for a in list(fn.args.args) + list(fn.args.kwonlyargs)}
+        tracers = params - statics - self._annotated_static_params(fn)
+        for n in ast.walk(fn):
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            if self._test_branches_on(n.test, tracers):
+                self.hit("tracer-branch", n.lineno,
+                         "Python branch on a traced value inside jitted "
+                         "code freezes one trace per call site; use "
+                         "jnp.where/lax.cond or make the argument static")
+
+    @staticmethod
+    def _test_branches_on(test, tracers: set[str]) -> bool:
+        exempt_roots = set()
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(test):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                exempt_roots.add(id(node))          # `x is (not) None`
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name) and node.id in tracers):
+                continue
+            # climb: exempt if under an is/is-not compare or behind an
+            # attribute access (x.ndim / x.shape — static under trace)
+            cur, under_attr = node, False
+            while cur is not None:
+                if id(cur) in exempt_roots:
+                    under_attr = True
+                    break
+                p = parents.get(id(cur))
+                if isinstance(p, ast.Attribute) and p.value is cur:
+                    under_attr = True
+                    break
+                cur = p
+            if not under_attr:
+                return True
+        return False
+
+    def _np_in_traced(self, fn: ast.FunctionDef) -> None:
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            if name.startswith("np.") or name.startswith("numpy."):
+                self.hit("np-in-traced", n.lineno,
+                         f"{name}() inside traced code runs on host per "
+                         "trace and constant-folds device data")
+
+    @staticmethod
+    def _scope_walk(stmt):
+        """Walk one statement without descending into nested function
+        scopes (each scope tracks its own device-value names)."""
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(stmt):
+            yield from _FileLint._scope_walk(child)
+
+    def _host_sync(self) -> None:
+        scopes = [s for s in ast.walk(self.tree)
+                  if isinstance(s, (ast.Module, ast.FunctionDef,
+                                    ast.AsyncFunctionDef))]
+        for scope in scopes:
+            device_names: set[str] = set()
+            for stmt in scope.body:
+                for n in self._scope_walk(stmt):
+                    if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                            and isinstance(n.targets[0], ast.Name):
+                        kind = _expr_transfer_kind(n.value, device_names)
+                        tgt = n.targets[0].id
+                        if kind == "device":
+                            device_names.add(tgt)
+                        else:
+                            device_names.discard(tgt)
+                    elif isinstance(n, ast.Call):
+                        self._check_sync_call(n, device_names)
+
+    def _check_sync_call(self, n: ast.Call, device_names: set[str]) -> None:
+        name = _call_name(n)
+        if name in HOST_SINKS or name in NP_SINKS:
+            for arg in n.args:
+                if _expr_transfer_kind(arg, device_names) == "device":
+                    self.hit("host-sync", n.lineno,
+                             f"{name}() on a device value is an implicit "
+                             "device->host sync; fetch once with "
+                             "jax.device_get")
+                    return
+        if isinstance(n.func, ast.Attribute) and n.func.attr in SYNC_METHODS:
+            if _expr_transfer_kind(n.func.value, device_names) == "device":
+                self.hit("host-sync", n.lineno,
+                         f".{n.func.attr}() on a device value is an "
+                         "implicit device->host sync; fetch once with "
+                         "jax.device_get")
+
+    def _missing_static(self, traced) -> None:
+        for fn, statics in traced:
+            if _jit_decoration(fn) is None \
+                    and fn.name not in self.call_form_jits:
+                continue       # convention-traced helpers: no jit signature
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+                ann = a.annotation
+                if isinstance(ann, ast.Name) and ann.id in ("int", "bool") \
+                        and a.arg not in statics:
+                    self.hit("missing-static", fn.lineno,
+                             f"parameter '{a.arg}: {ann.id}' of jitted "
+                             f"'{fn.name}' is not in static_argnames — it "
+                             "will be traced (retrace per value or "
+                             "ConcretizationError)")
+
+    def _jit_declarations(self) -> None:
+        sites: list[tuple[str, set[str], tuple[int, ...], int]] = []
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.FunctionDef):
+                dec = _jit_decoration(n)
+                if dec is not None:
+                    sites.append((n.name, dec[0], dec[1], n.lineno))
+        for fname, (statics, donate, lineno) in self.call_form_jits.items():
+            sites.append((fname, statics, donate, lineno))
+        for fname, statics, donate, lineno in sites:
+            declared = JIT_DECLARATIONS.get((self.rel, fname))
+            if declared is None:
+                self.hit("jit-undeclared", lineno,
+                         f"jit site '{fname}' is not declared in "
+                         "analysis.ast_lint.JIT_DECLARATIONS — register "
+                         "its static/donate signature (and a jaxpr-audit "
+                         "entrypoint if it is a hot kernel)")
+                continue
+            want_statics, want_donate = set(declared[0]), tuple(declared[1])
+            if statics != want_statics or tuple(donate) != want_donate:
+                self.hit("jit-signature", lineno,
+                         f"jit site '{fname}' signature drifted: "
+                         f"static_argnames={sorted(statics)} "
+                         f"donate_argnums={tuple(donate)} declared "
+                         f"{sorted(want_statics)}/{want_donate}")
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parent.parent
+
+
+def lint_tree(root: "Path | str | None" = None) -> Report:
+    """Lint every .py under ``root`` (default: the installed package)."""
+    base = Path(root) if root is not None else package_root()
+    check_decls = root is None
+    report = Report()
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        try:
+            lint = _FileLint(path, rel, path.read_text())
+        except SyntaxError as exc:
+            report.findings.append(Finding(
+                rule="syntax-error", where=f"{rel}:{exc.lineno or 0}",
+                message=str(exc), pass_name="ast"))
+            continue
+        report.findings.extend(lint.lint(check_decls))
+    return report

@@ -1,0 +1,84 @@
+"""Finding/Report model shared by the graft-audit passes.
+
+A Finding is one rule hit at one location; a Report aggregates findings
+across passes, separates waived sites (explicit ``# graft-audit:
+allow[rule]`` pragmas) from violations, and serializes to the JSON shape
+the CI artifact carries.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str            # e.g. "forbidden-primitive", "broad-except"
+    where: str           # "path:line" (ast) or "entrypoint-name" (jaxpr)
+    message: str
+    pass_name: str       # "jaxpr" | "ast" | "runtime"
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def to_dict(self) -> dict:
+        d = {"rule": self.rule, "where": self.where,
+             "message": self.message, "pass": self.pass_name}
+        if self.waived:
+            d["waived"] = True
+            d["waiver_reason"] = self.waiver_reason
+        return d
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    entrypoints_audited: list[str] = field(default_factory=list)
+
+    def extend(self, other: "Report | list[Finding]") -> None:
+        if isinstance(other, Report):
+            self.findings.extend(other.findings)
+            self.entrypoints_audited.extend(other.entrypoints_audited)
+        else:
+            self.findings.extend(other)
+
+    @property
+    def violations(self) -> list[Finding]:
+        return [f for f in self.findings if not f.waived]
+
+    @property
+    def waivers(self) -> list[Finding]:
+        return [f for f in self.findings if f.waived]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.violations else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "tool": "graft-audit",
+            "ok": not self.violations,
+            "summary": {
+                "violations": len(self.violations),
+                "waived": len(self.waivers),
+                "entrypoints_audited": len(self.entrypoints_audited),
+            },
+            "entrypoints": self.entrypoints_audited,
+            "violations": [f.to_dict() for f in self.violations],
+            "waived": [f.to_dict() for f in self.waivers],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def to_text(self) -> str:
+        lines = []
+        for f in self.violations:
+            lines.append(f"VIOLATION [{f.pass_name}/{f.rule}] {f.where}: {f.message}")
+        for f in self.waivers:
+            lines.append(f"waived    [{f.pass_name}/{f.rule}] {f.where}: "
+                         f"{f.waiver_reason or f.message}")
+        lines.append(
+            f"graft-audit: {len(self.violations)} violation(s), "
+            f"{len(self.waivers)} waived site(s), "
+            f"{len(self.entrypoints_audited)} entrypoint(s) audited")
+        return "\n".join(lines)

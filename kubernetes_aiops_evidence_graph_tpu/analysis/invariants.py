@@ -1,0 +1,154 @@
+"""Declarative jaxpr invariants + the walker that enforces them.
+
+The unit of policy is an :class:`InvariantSpec` attached to a registered
+entrypoint (registry.py). The walker recurses through every sub-jaxpr
+(pjit, scan, while, cond branches, shard_map, custom_* calls) so an
+invariant holds for the WHOLE traced computation, not just the top level.
+
+The invariants encode what PR 1 measured, not aesthetics:
+
+* 2-D scatters (``scatter_dims_to_operand_dims`` rank >= 2) serialize on
+  TPU — the scatter-bucket GNN variant measured 9.4x slower than the
+  reference (rca/gnn.py module docstring); nothing may reintroduce one.
+* a per-intermediate byte budget rejects any [N, R, H]-scale
+  materialization — the dense transform-then-gather path writes+rereads
+  151 MB/layer at the 50k bench config and held the reference to 7.8% of
+  roofline.
+* f64 anywhere means an accidental x64 upcast doubling HBM traffic.
+* bf16 matmul operands must accumulate into f32
+  (``preferred_element_type``) — one rounding per product term, never a
+  bf16 running sum.
+* host callbacks (pure/io/debug) in a hot kernel mean a device→host sync
+  per dispatch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+# every scatter-family primitive name (set/add/mul/min/max)
+SCATTER_PRIMS = frozenset(
+    {"scatter", "scatter-add", "scatter-mul", "scatter-min", "scatter-max"})
+# host-callback primitives: any of these in a hot kernel is a per-dispatch
+# device→host round trip
+CALLBACK_PRIMS = frozenset({"pure_callback", "io_callback", "debug_callback"})
+_F64_DTYPES = ("float64", "complex128")
+
+
+@dataclass(frozen=True)
+class InvariantSpec:
+    """What one entrypoint's jaxpr must satisfy."""
+    # primitive names that must not appear anywhere in the trace
+    forbid_primitives: frozenset = CALLBACK_PRIMS
+    # no scatter with >= 2 scatter_dims_to_operand_dims (TPU serializes)
+    forbid_2d_scatter: bool = True
+    # no float64/complex128 aval anywhere (accidental x64 creep)
+    forbid_f64: bool = True
+    # largest allowed per-eqn output intermediate, in bytes (None = unbounded);
+    # sized to reject [N, R, H]-scale materialization at the canonical shapes
+    max_intermediate_bytes: int | None = None
+    # every dot_general with a bf16 operand must accumulate into f32
+    bf16_accum_f32: bool = False
+    # at least one scatter must carry indices_are_sorted=True (proves the
+    # slices_sorted/sorted_by_dst promise actually reached the kernel)
+    expect_sorted_scatter: bool = False
+
+
+def _iter_sub_jaxprs(value):
+    """Yield every Jaxpr reachable from one eqn param value."""
+    if value is None:
+        return
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):  # ClosedJaxpr
+        yield value.jaxpr
+    elif hasattr(value, "eqns"):                                  # raw Jaxpr
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _iter_sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over all equations of ``jaxpr`` and its sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for pv in eqn.params.values():
+            for sub in _iter_sub_jaxprs(pv):
+                yield from iter_eqns(sub)
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def _scatter_index_rank(eqn) -> int:
+    dn = eqn.params.get("dimension_numbers")
+    dims = getattr(dn, "scatter_dims_to_operand_dims", ())
+    return len(dims)
+
+
+def check_jaxpr(name: str, closed_jaxpr, spec: InvariantSpec) -> list[Finding]:
+    """Walk one traced entrypoint against its spec; one Finding per hit."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    findings: list[Finding] = []
+
+    def hit(rule: str, message: str) -> None:
+        findings.append(Finding(rule=rule, where=name, message=message,
+                                pass_name="jaxpr"))
+
+    if spec.forbid_f64:
+        for v in list(jaxpr.invars) + list(jaxpr.constvars):
+            dt = str(getattr(v.aval, "dtype", ""))
+            if dt in _F64_DTYPES:
+                hit("no-f64", f"{dt} input/const aval {v.aval}")
+
+    saw_sorted_scatter = False
+    peak_bytes, peak_desc = 0, ""
+    for eqn in iter_eqns(jaxpr):
+        prim = eqn.primitive.name
+        if prim in spec.forbid_primitives:
+            hit("forbidden-primitive", f"primitive '{prim}' is forbidden here")
+        if prim in SCATTER_PRIMS:
+            if eqn.params.get("indices_are_sorted"):
+                saw_sorted_scatter = True
+            if spec.forbid_2d_scatter and _scatter_index_rank(eqn) >= 2:
+                hit("no-2d-scatter",
+                    f"'{prim}' with scatter_dims_to_operand_dims="
+                    f"{_scatter_index_rank(eqn)}-D index (TPU scatters "
+                    "serialize; see rca/gnn.py — measured 9.4x slower)")
+        for v in eqn.outvars:
+            if spec.forbid_f64:
+                dt = str(getattr(v.aval, "dtype", ""))
+                if dt in _F64_DTYPES:
+                    hit("no-f64", f"{dt} intermediate from '{prim}': {v.aval}")
+            b = _aval_bytes(v.aval)
+            if b > peak_bytes:
+                peak_bytes, peak_desc = b, f"'{prim}' -> {v.aval}"
+        if spec.bf16_accum_f32 and prim == "dot_general":
+            in_dts = [str(getattr(v.aval, "dtype", "")) for v in eqn.invars]
+            out_dt = str(eqn.outvars[0].aval.dtype)
+            if "bfloat16" in in_dts and out_dt != "float32":
+                hit("bf16-accum",
+                    f"dot_general({'/'.join(in_dts)}) accumulates into "
+                    f"{out_dt}; bf16 operands must accumulate into f32 "
+                    "(preferred_element_type)")
+
+    if (spec.max_intermediate_bytes is not None
+            and peak_bytes > spec.max_intermediate_bytes):
+        hit("byte-budget",
+            f"largest intermediate {peak_bytes} B ({peak_desc}) exceeds the "
+            f"{spec.max_intermediate_bytes} B budget — [N, R, H]-scale "
+            "materialization in a bucketed path")
+    if spec.expect_sorted_scatter and not saw_sorted_scatter:
+        hit("sorted-scatter-lost",
+            "no scatter carries indices_are_sorted=True although the "
+            "layout promises a sorted fast path — the static flag is not "
+            "reaching the kernel")
+    return findings

@@ -1,0 +1,45 @@
+"""Pass 1 — trace every registered entrypoint and walk its jaxpr.
+
+Builders hand back (callable-with-statics-bound, args); tracing is
+``jax.make_jaxpr`` — abstract evaluation only, so the canonical bench
+shapes cost nothing to audit. A builder or trace failure is itself a
+violation (rule ``trace-error``): a hot path that can no longer be traced
+with its registered shapes is exactly the kind of silent drift this pass
+exists to catch.
+"""
+from __future__ import annotations
+
+from .findings import Finding, Report
+from .invariants import check_jaxpr
+from .registry import SkipEntrypoint
+
+
+def trace_entrypoint(entry) -> "object":
+    """Build + make_jaxpr one registry entry (statics already bound)."""
+    import jax
+    fn, args = entry.build()
+    return jax.make_jaxpr(fn)(*args)
+
+
+def audit_entrypoints(entrypoints) -> Report:
+    report = Report()
+    for entry in entrypoints:
+        try:
+            jaxpr = trace_entrypoint(entry)
+        except SkipEntrypoint as exc:
+            report.entrypoints_audited.append(f"{entry.name} (skipped: {exc})")
+            continue
+        except Exception as exc:  # graft-audit: allow[broad-except] any trace failure must surface as a finding, not crash the audit
+            report.findings.append(Finding(
+                rule="trace-error", where=entry.name,
+                message=f"{type(exc).__name__}: {exc}", pass_name="jaxpr"))
+            report.entrypoints_audited.append(f"{entry.name} (trace failed)")
+            continue
+        report.findings.extend(check_jaxpr(entry.name, jaxpr, entry.spec))
+        report.entrypoints_audited.append(entry.name)
+    return report
+
+
+def audit_registered_entrypoints() -> Report:
+    from .registry import ENTRYPOINTS
+    return audit_entrypoints(ENTRYPOINTS)

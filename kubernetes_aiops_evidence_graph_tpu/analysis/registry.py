@@ -1,0 +1,341 @@
+"""Hot-path entrypoint registry for the jaxpr audit.
+
+Every entrypoint the perf work of PR 1 touched is registered here with
+CANONICAL BENCH SHAPES (scaled-down but scale-separated: a [N, R, H]
+materialization is ~5-10x the largest legitimate intermediate, so the
+byte budget cleanly splits them) and the invariant spec it must satisfy.
+Tracing is abstract (jax.make_jaxpr) — no FLOPs run, so registering big
+shapes is free.
+
+Adding a new jitted hot-path kernel? Register it here AND declare its
+static/donate signature in ast_lint.JIT_DECLARATIONS — the self-audit
+test (tests/test_graft_audit.py) and CI fail otherwise.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+from .invariants import CALLBACK_PRIMS, InvariantSpec
+
+MIB = 1 << 20
+
+# canonical bench shapes (module-level so tests can assert against them)
+N_NODES = 16384        # padded node rows
+HIDDEN = 64
+LAYERS = 3
+N_INC = 128            # padded incident rows
+# per-relation live edge counts for the 9 RelationKinds — drawn so the
+# ladder caps are exact powers of two (rel_slice_offsets identity)
+REL_COUNTS = (4096, 4096, 2048, 2048, 1024, 1024, 512, 512, 256)
+
+# the hot-path budget: comfortably above the largest legitimate
+# intermediate at the canonical shapes ([N, H] f32 = 4 MiB) and far below
+# a [N, R, H] materialization (36 MiB) or a full [E, H] message table
+HOT_BUDGET = 8 * MIB
+# the reference (parity-oracle) path's budget pins its KNOWN peak — the
+# [N, R, H] einsum (36 MiB at canonical shapes); anything beyond that is
+# new regression even for the oracle
+REFERENCE_BUDGET = 40 * MIB
+
+# bucketed forward paths may not contain a set-scatter at all — the only
+# scatters are the per-slice 1-D dst segment-adds
+NO_SET_SCATTER = CALLBACK_PRIMS | frozenset({"scatter"})
+
+
+class SkipEntrypoint(Exception):
+    """Raised by a builder when its environment can't trace it (e.g. a
+    sharded entry on a single-device host) — recorded, not a violation."""
+
+
+@dataclass(frozen=True)
+class Entrypoint:
+    name: str
+    # () -> (callable, args tuple); statics must already be bound
+    build: Callable[[], tuple[Callable, tuple]]
+    spec: InvariantSpec
+    notes: str = ""
+
+
+def _np():
+    import numpy as np
+    return np
+
+
+def _rel_offsets():
+    from ..graph.snapshot import rel_slice_offsets
+    return rel_slice_offsets(REL_COUNTS)
+
+
+def _gnn_arrays(n: int = N_NODES, b: int = N_INC):
+    """Canonical relation-bucketed snapshot arrays (concrete, cheap)."""
+    np = _np()
+    from ..graph.schema import DIM
+    offs = _rel_offsets()
+    pe = int(offs[-1])
+    rng = np.random.default_rng(0)
+    edge_src = rng.integers(0, n, pe).astype(np.int32)
+    edge_dst = np.zeros(pe, np.int32)
+    edge_rel = np.full(pe, -1, np.int32)
+    edge_mask = np.zeros(pe, np.float32)
+    for r, (lo, hi) in enumerate(zip(offs[:-1], offs[1:])):
+        c = REL_COUNTS[r]
+        # live prefix dst-sorted per the snapshot layout contract
+        edge_dst[lo:lo + c] = np.sort(rng.integers(0, n, c)).astype(np.int32)
+        edge_dst[lo + c:hi] = n - 1          # padding pinned to last row
+        edge_rel[lo:lo + c] = r
+        edge_mask[lo:lo + c] = 1.0
+    return {
+        "features": np.zeros((n, DIM), np.float32),
+        "node_kind": np.zeros(n, np.int32),
+        "node_mask": np.ones(n, np.float32),
+        "edge_src": edge_src,
+        "edge_dst": edge_dst,
+        "edge_rel": edge_rel,
+        "edge_mask": edge_mask,
+        "incident_nodes": np.zeros(b, np.int32),
+        "incident_mask": np.ones(b, np.float32),
+        "rel_offsets": offs,
+    }
+
+
+def _params():
+    import jax
+    from ..rca import gnn
+    return gnn.init_params(jax.random.PRNGKey(0), hidden=HIDDEN,
+                           layers=LAYERS)
+
+
+def _forward_entry(compute_dtype=None, bucketed: bool = True,
+                   slices_sorted: bool = True):
+    def build():
+        from ..rca import gnn
+        a = _gnn_arrays()
+        params = _params()
+        if bucketed:
+            fn = partial(gnn.forward, rel_offsets=a["rel_offsets"],
+                         slices_sorted=slices_sorted,
+                         compute_dtype=compute_dtype)
+        else:
+            fn = partial(gnn.forward, sorted_by_dst=True)
+        args = (params, a["features"], a["node_kind"], a["node_mask"],
+                a["edge_src"], a["edge_dst"], a["edge_rel"], a["edge_mask"],
+                a["incident_nodes"])
+        return fn, args
+    return build
+
+
+def _train_step_build():
+    try:
+        import optax
+    except ImportError as exc:                  # pragma: no cover
+        raise SkipEntrypoint(f"optax unavailable: {exc}")
+    from ..rca import gnn
+    a = _gnn_arrays()
+    np = _np()
+    params = _params()
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    step = gnn.make_train_step(tx)
+    batch = {k: a[k] for k in (
+        "features", "node_kind", "node_mask", "edge_src", "edge_dst",
+        "edge_rel", "edge_mask", "incident_nodes")}
+    batch["labels"] = np.zeros(N_INC, np.int32)
+    batch["label_mask"] = a["incident_mask"]
+    fn = partial(step, rel_offsets=a["rel_offsets"], slices_sorted=True)
+    return fn, (params, opt_state, batch)
+
+
+def _sharded_build(halo: str):
+    def build():
+        import jax
+        if len(jax.devices()) < 2:
+            raise SkipEntrypoint("needs >= 2 devices for the graph axis")
+        np = _np()
+        from ..parallel.mesh import make_mesh
+        from ..parallel.sharded_gnn import _sharded_loss
+        d = len(jax.devices())
+        graph = 2
+        dp = d // graph
+        mesh = make_mesh(dp=dp, graph=graph)
+        a = _gnn_arrays()
+        n, b = N_NODES, N_INC
+        nps = n // graph
+        offs = a["rel_offsets"]
+        pe_shard = int(offs[-1])
+        # PartitionedGraph shapes (parallel/partition.py): node/edge
+        # arrays carry a leading [G] shard axis, incidents a leading [dp]
+        # axis; the per-shard slice tables are SHARED, so every shard sees
+        # one full offsets-worth of edge rows with LOCAL dst
+        def g_stack(x):
+            return np.stack([x] * graph)
+        loss = _sharded_loss(mesh, halo=halo, rel_offsets=offs,
+                             slices_sorted=(halo == "allgather"))
+        args = (
+            _params(),
+            a["features"].reshape(graph, nps, -1),
+            a["node_kind"].reshape(graph, nps),
+            a["node_mask"].reshape(graph, nps),
+            g_stack(a["edge_src"]),
+            g_stack(np.clip(a["edge_dst"], 0, nps - 1)),
+            g_stack(a["edge_rel"]), g_stack(a["edge_mask"]),
+            a["incident_nodes"].reshape(dp, b // dp),
+            a["incident_mask"].reshape(dp, b // dp),
+            np.zeros((dp, b // dp), np.int32),
+        )
+        assert args[4].shape == (graph, pe_shard)
+        return loss, args
+    return build
+
+
+def _rules_tick_build():
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.streaming import _tick
+    pn, pi, width, pair_width, pk, rk = 4096, 32, 128, 16, 64, 4
+    ints = np.zeros(pk + 2 * rk + 2 * rk * width, np.int32)
+    fn = partial(_tick, padded_incidents=pi, pair_width=pair_width,
+                 pk=pk, rk=rk, width=width)
+    args = (np.zeros((pn, DIM), np.float32), ints,
+            np.zeros((pk, DIM), np.float32),
+            np.zeros((pi, width), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, width), pair_width, np.int32),
+            np.zeros(pi, np.float32))
+    return fn, args
+
+
+def _gnn_tick_build():
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.gnn_streaming import _gnn_tick
+    offs = _rel_offsets()
+    pn, pi, pk, ek = 4096, 32, 64, 256
+    pe = int(offs[-1])
+    ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
+    # the mirror never promises slices_sorted (slot reuse under churn)
+    fn = partial(_gnn_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                 slices_sorted=False, compute_dtype=None)
+    args = (_params(), np.zeros((pn, DIM), np.float32),
+            np.zeros(pn, np.int32), np.ones(pn, np.float32),
+            np.zeros(pe, np.int32), np.zeros(pe, np.int32),
+            np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
+    return fn, args
+
+
+def _gms_build(compute_dtype=None):
+    def build():
+        np = _np()
+        from ..ops.segment import gather_matmul_segment
+        offs = _rel_offsets()
+        n, h = 8192, HIDDEN
+        pe = int(offs[-1])
+        fn = partial(gather_matmul_segment, rel_offsets=offs,
+                     num_segments=n, slices_sorted=True,
+                     compute_dtype=compute_dtype)
+        args = (np.zeros((n, h), np.float32),
+                np.zeros((len(REL_COUNTS), h, h), np.float32),
+                np.zeros(pe, np.int32), np.zeros(pe, np.int32),
+                np.zeros(pe, np.float32))
+        return fn, args
+    return build
+
+
+def _k_hop_build():
+    np = _np()
+    from ..ops.propagate import k_hop_reach
+    n, e, b = 4096, 16384, 32
+    fn = partial(k_hop_reach, num_nodes=n, hops=3)
+    args = (np.zeros(b, np.int32), np.ones(b, np.float32),
+            np.zeros(e, np.int32), np.zeros(e, np.int32),
+            np.ones(e, np.float32))
+    return fn, args
+
+
+def _propagate_build():
+    np = _np()
+    from ..ops.propagate import propagate_labels
+    n, e = 65536, 262144
+    fn = partial(propagate_labels, num_nodes=n, iterations=3)
+    args = (np.zeros(n, np.float32), np.zeros(e, np.int32),
+            np.zeros(e, np.int32), np.ones(e, np.float32))
+    return fn, args
+
+
+def _score_device_build():
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.tpu_backend import _score_device
+    pn, pi, w, pw = N_NODES, N_INC, 128, 16
+    fn = partial(_score_device, padded_incidents=pi, pair_width=pw)
+    args = (np.zeros((pn, DIM), np.float32),
+            np.zeros((pi, w), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, w), pw, np.int32), np.zeros(pi, np.float32))
+    return fn, args
+
+
+_HOT = InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                     max_intermediate_bytes=HOT_BUDGET,
+                     expect_sorted_scatter=True)
+# resident-state ticks legitimately apply deltas via 1-D set-scatters, and
+# their mirror never promises within-slice dst order under churn
+_TICK = InvariantSpec(max_intermediate_bytes=HOT_BUDGET)
+
+
+ENTRYPOINTS: tuple[Entrypoint, ...] = (
+    Entrypoint(
+        "gnn.forward.bucketed", _forward_entry(), _HOT,
+        notes="relation-bucketed hot path, slices_sorted fast path"),
+    Entrypoint(
+        "gnn.forward.bucketed.bf16",
+        _forward_entry(compute_dtype="bfloat16"),
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET,
+                      expect_sorted_scatter=True, bf16_accum_f32=True),
+        notes="bf16 matmul operands must accumulate into f32"),
+    Entrypoint(
+        "gnn.forward.reference", _forward_entry(bucketed=False),
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=REFERENCE_BUDGET,
+                      expect_sorted_scatter=True),
+        notes="transform-then-gather parity oracle; budget pins its known "
+              "[N, R, H] peak so even the oracle cannot regress further"),
+    Entrypoint(
+        "gnn.train_step.bucketed", _train_step_build,
+        InvariantSpec(max_intermediate_bytes=HOT_BUDGET,
+                      expect_sorted_scatter=True),
+        notes="value_and_grad + adam through the bucketed kernel (gather "
+              "transposes are 1-D scatter-adds)"),
+    Entrypoint(
+        "sharded_gnn.loss.allgather.bucketed", _sharded_build("allgather"),
+        InvariantSpec(max_intermediate_bytes=HOT_BUDGET,
+                      expect_sorted_scatter=True)),
+    Entrypoint(
+        "sharded_gnn.loss.ring.bucketed", _sharded_build("ring"),
+        InvariantSpec(max_intermediate_bytes=HOT_BUDGET),
+        notes="ring halo: per-block mask breaks the per-slice sorted "
+              "promise, so no sorted-scatter expectation"),
+    Entrypoint("streaming.rules_tick", _rules_tick_build, _TICK),
+    Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
+    Entrypoint("ops.gather_matmul_segment", _gms_build(), _HOT),
+    Entrypoint(
+        "ops.gather_matmul_segment.bf16", _gms_build("bfloat16"),
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET,
+                      expect_sorted_scatter=True, bf16_accum_f32=True)),
+    Entrypoint(
+        "ops.k_hop_reach", _k_hop_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="seed init is a dense one-hot, frontier scatter-max stays "
+              "1-D per vmap lane"),
+    Entrypoint(
+        "ops.propagate_labels", _propagate_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET)),
+    Entrypoint(
+        "rules.score_device", _score_device_build,
+        InvariantSpec(max_intermediate_bytes=HOT_BUDGET),
+        notes="dense evidence fold — no per-edge scatter at all; the "
+              "static-index condition writes lower to 1-D set-scatters"),
+)

@@ -1,0 +1,90 @@
+"""Pass 3 — runtime guards: transfer discipline + recompilation hazards.
+
+Static passes can't see everything: whether the streaming paths actually
+stay inside the REL_SLICE_BUCKETS retrace ladder under churn, and whether
+the serving path really performs only explicit host transfers, are
+runtime properties. These helpers are layered by the pytest fixture in
+tests/test_graft_audit.py (marker ``static_audit``) around the
+streaming-churn workload.
+
+* :func:`no_implicit_transfers` — ``jax.transfer_guard`` context. On a
+  real accelerator an implicit device→host sync (``.item()``, stray
+  ``np.asarray``) raises; on the CPU backend transfers are free so the
+  guard is a no-op — the AST host-sync rule is the backstop there.
+* :class:`CompileCounter` — wraps jitted callables, tracking executable-
+  cache growth AND the distinct static keys observed, so a test can
+  assert compiles == distinct keys (no silent retrace) and that every
+  key is drawn from the declared ladder.
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def no_implicit_transfers(device_to_host: bool = True,
+                          host_to_device: bool = True):
+    """Disallow implicit transfers in the wrapped block (explicit
+    jax.device_get / device_put remain allowed). Serving paths that
+    intentionally feed host-built delta arrays each tick guard only the
+    device→host direction."""
+    import jax
+    with contextlib.ExitStack() as stack:
+        if device_to_host:
+            stack.enter_context(jax.transfer_guard_device_to_host("disallow"))
+        if host_to_device:
+            stack.enter_context(jax.transfer_guard_host_to_device("disallow"))
+        yield
+
+
+@dataclass
+class CompileCounter:
+    """Executable-cache watcher for one jitted callable.
+
+    ``permitted`` is the retrace budget: the number of DISTINCT static
+    keys the bucket ladders allow the workload to mint. ``over_budget``
+    is the recompilation-hazard signal — more cache entries than distinct
+    static keys means something non-static is leaking into the trace.
+    """
+    fn: "object"                       # the jitted callable (has _cache_size)
+    static_argnames: tuple = ()
+    baseline: int = 0
+    keys_seen: set = field(default_factory=set)
+
+    def __post_init__(self):
+        self.baseline = self._cache_size()
+
+    def _cache_size(self) -> int:
+        try:
+            return int(self.fn._cache_size())
+        except Exception:  # graft-audit: allow[broad-except] private-API probe; counter degrades to key-only mode
+            return 0
+
+    def record(self, **static_kwargs) -> None:
+        """Record one call's static key (call from a thin wrapper)."""
+        key = tuple(sorted(
+            (k, v if isinstance(v, (int, bool, str, tuple, type(None)))
+             else repr(v))
+            for k, v in static_kwargs.items()))
+        self.keys_seen.add(key)
+
+    @property
+    def compiles(self) -> int:
+        return self._cache_size() - self.baseline
+
+    def over_budget(self, permitted: int) -> bool:
+        return self.compiles > permitted
+
+    def summary(self) -> dict:
+        return {"compiles": self.compiles,
+                "distinct_static_keys": len(self.keys_seen)}
+
+
+def ladder_retrace_budget(delta_buckets, edge_buckets=None) -> int:
+    """Upper bound on distinct static keys the delta ladders permit for
+    one resident shape set (pk × ek combinations; offsets changes rebuild
+    the resident state and are counted by the caller separately)."""
+    pk = len(tuple(delta_buckets))
+    ek = len(tuple(edge_buckets if edge_buckets is not None else delta_buckets))
+    return pk * ek

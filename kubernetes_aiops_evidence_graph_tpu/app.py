@@ -51,7 +51,7 @@ class AiopsApp:
                     self.builder.store = EvidenceGraphStore.load(path)
                     log.info("graph_restored", path=path,
                              nodes=self.builder.store.node_count())
-                except Exception as exc:
+                except Exception as exc:  # graft-audit: allow[broad-except] corrupt persisted graph must not block startup; moved aside below
                     bad = path + ".corrupt"
                     try:
                         os.replace(path, bad)
@@ -106,7 +106,7 @@ class AiopsApp:
             try:
                 asyncio.run_coroutine_threadsafe(
                     self.worker.drain(), self._loop).result(timeout=30)
-            except Exception as exc:  # drain stuck (e.g. pending approval)
+            except Exception as exc:  # graft-audit: allow[broad-except] drain stuck (e.g. pending approval); force shutdown
                 log.warning("drain_timeout_forcing_stop", error=str(exc))
             self.worker.stop_warm()   # idempotent; covers a stuck drain
             self._loop.call_soon_threadsafe(self._loop.stop)
@@ -118,7 +118,7 @@ class AiopsApp:
                 log.info("graph_persisted",
                          path=self.settings.graph_persist_path,
                          records=written)
-        except Exception as exc:   # never let persistence block shutdown
+        except Exception as exc:  # graft-audit: allow[broad-except] never let persistence block shutdown
             log.error("graph_persist_failed", error=str(exc))
         finally:
             if self._otlp is not None:
@@ -131,7 +131,7 @@ class AiopsApp:
         try:
             self.db.query("SELECT 1")
             return self._loop is not None and self._loop.is_running()
-        except Exception:
+        except Exception:  # graft-audit: allow[broad-except] readiness probe: any failure reads as not-ready
             return False
 
     # -- ingestion path (main.py:345-425 analog) --------------------------

@@ -33,7 +33,7 @@ class BaseCollector:
         try:
             result = self.collect(incident)
             result.collector_name = self.name
-        except Exception as exc:  # error isolation (base.py:71-86)
+        except Exception as exc:  # graft-audit: allow[broad-except] collector isolation (base.py:71-86): one bad collector never sinks the evidence pass
             result = CollectorResult(collector_name=self.name, success=False, errors=[str(exc)])
         result.duration_seconds = time.perf_counter() - t0
         observe_collector(self.name, result)

@@ -18,6 +18,7 @@ for out-of-cluster use.
 """
 from __future__ import annotations
 
+import http.client
 import json
 import ssl
 import urllib.error
@@ -158,7 +159,7 @@ class LiveClusterBackend:
             with urllib.request.urlopen(req, timeout=self.timeout_s,
                                         context=self._ctx) as resp:
                 return 200 <= resp.status < 300
-        except Exception as exc:
+        except (OSError, http.client.HTTPException) as exc:
             self._log.error("k8s_write_failed", method=method, path=path,
                             error=str(exc))
             return False
@@ -369,7 +370,7 @@ class LiveClusterBackend:
             data = self._get(self.loki_url, "/loki/api/v1/query_range", {
                 "query": logql, "limit": limit, "direction": "backward",
             })
-        except Exception as exc:
+        except (OSError, ValueError, http.client.HTTPException) as exc:
             self._log.warning("loki_query_failed", error=str(exc))
             return []
         lines: list[str] = []
@@ -400,7 +401,7 @@ class LiveClusterBackend:
             return None
         try:
             data = self._get(self.prometheus_url, "/api/v1/query", {"query": promql})
-        except Exception as exc:
+        except (OSError, ValueError, http.client.HTTPException) as exc:
             self._log.warning("prometheus_query_failed", error=str(exc))
             return None
         results = ((data.get("data") or {}).get("result") or [])
@@ -431,7 +432,7 @@ class LiveClusterBackend:
                 "query": promql, "start": int(start_s), "end": int(end_s),
                 "step": step,
             })
-        except Exception as exc:
+        except (OSError, ValueError, http.client.HTTPException) as exc:
             self._log.warning("prometheus_query_range_failed", error=str(exc))
             return []
         samples: list[tuple[float, float]] = []

@@ -87,7 +87,7 @@ class ApiHandler(BaseHTTPRequestHandler):
             if match:
                 try:
                     getattr(self, name)(**match.groupdict())
-                except Exception as exc:
+                except Exception as exc:  # graft-audit: allow[broad-except] HTTP boundary: handler errors become a 500, server stays up
                     log.error("handler_error", path=parsed.path, error=str(exc))
                     self._json(500, {"error": str(exc)})
                 return
@@ -279,11 +279,14 @@ class ApiHandler(BaseHTTPRequestHandler):
 
     @route("POST", r"/api/v1/hypotheses/(?P<hypothesis_id>[0-9a-f-]+)/feedback")
     def submit_feedback(self, hypothesis_id: str):
+        from pydantic import ValidationError
+
         from ..models import HypothesisFeedback
         body = self._body()
         try:
             fb = HypothesisFeedback(hypothesis_id=hypothesis_id, **body)
-        except Exception as exc:
+        except (ValidationError, TypeError) as exc:
+            # bad request body: pydantic validation or non-str kwargs
             self._json(400, {"error": str(exc)})
             return
         if not self.app.db.insert_feedback(fb):

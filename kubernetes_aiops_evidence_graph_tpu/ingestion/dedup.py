@@ -64,8 +64,8 @@ class AlertDeduplicator:
     def check_duplicate(self, fingerprint: str) -> bool:
         try:
             return fingerprint in self._seen
-        except Exception:
-            return False  # fail open (deduplicator.py:69-72)
+        except Exception:  # graft-audit: allow[broad-except] fail open (deduplicator.py:69-72): dedup errors must not drop alerts
+            return False
 
     def register_fingerprint(self, fingerprint: str) -> None:
         self._seen.add(fingerprint, self.settings.dedup_ttl_seconds)

@@ -49,7 +49,9 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_uint8),
             ]
             _lib = lib
-        except Exception:
+        except (OSError, subprocess.SubprocessError, AttributeError):
+            # no toolchain / bad .so / missing symbol: fall back to the
+            # pure-Python implementations
             _failed = True
     return _lib
 

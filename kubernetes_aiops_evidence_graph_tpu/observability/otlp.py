@@ -10,6 +10,7 @@ blocks or fails the pipeline (same degradation polarity as collectors).
 """
 from __future__ import annotations
 
+import http.client
 import json
 import threading
 import urllib.request
@@ -114,7 +115,9 @@ class OtlpExporter:
             with self._lock:   # daemon flush and manual flush/close race
                 self._exported += len(batch)
             return len(batch)
-        except Exception:
+        except (OSError, http.client.HTTPException):
+            # dead/unreachable collector: drop the batch, never block or
+            # fail the traced path (export is best-effort by design)
             with self._lock:
                 self._dropped += len(batch)
             return 0

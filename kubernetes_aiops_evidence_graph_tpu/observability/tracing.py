@@ -21,13 +21,20 @@ class Span:
     span_id: str
     parent_id: Optional[str]
     name: str
+    # absolute epoch bounds (OTLP export needs wall-clock nanos); the
+    # DURATION is measured on the monotonic clock — an NTP step between
+    # start and end must never yield a negative span
     start_s: float
     end_s: float = 0.0
     attributes: dict[str, Any] = field(default_factory=dict)
     status: str = "ok"
+    start_mono: float = 0.0
+    end_mono: float = 0.0
 
     @property
     def duration_ms(self) -> float:
+        if self.end_mono or self.start_mono:
+            return (self.end_mono - self.start_mono) * 1e3
         return (self.end_s - self.start_s) * 1e3
 
     def to_dict(self) -> dict:
@@ -61,7 +68,9 @@ class Tracer:
             span_id=uuid.uuid4().hex[:16],
             parent_id=parent.span_id if parent else None,
             name=name,
+            # graft-audit: allow[wall-clock] absolute epoch field for OTLP startTimeUnixNano; the duration uses start_mono
             start_s=time.time(),
+            start_mono=time.monotonic(),
             attributes=attributes,
         )
         stack = getattr(self._tls, "stack", None)
@@ -74,7 +83,11 @@ class Tracer:
             s.status = f"error:{type(exc).__name__}"
             raise
         finally:
-            s.end_s = time.time()
+            s.end_mono = time.monotonic()
+            # derive the epoch end from the monotonic duration so the
+            # exported span is internally consistent even across an NTP
+            # step mid-span
+            s.end_s = s.start_s + (s.end_mono - s.start_mono)
             stack.pop()
             with self._lock:
                 self._spans.append(s)
@@ -83,8 +96,8 @@ class Tracer:
             if self.on_end is not None:
                 try:
                     self.on_end(s)
-                except Exception:
-                    pass  # telemetry must never break the traced path
+                except Exception:  # graft-audit: allow[broad-except] telemetry hook must never break the traced path
+                    pass
 
     def export(self, trace_id: str | None = None) -> list[dict]:
         with self._lock:

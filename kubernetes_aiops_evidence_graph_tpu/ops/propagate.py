@@ -34,9 +34,11 @@ def k_hop_reach(
     hops: int,
 ) -> jax.Array:
     """Reachability within `hops` edges: returns float [B, num_nodes]."""
-    batch = seed_rows.shape[0]
-    reach0 = jnp.zeros((batch, num_nodes), jnp.float32)
-    reach0 = reach0.at[jnp.arange(batch), seed_rows].max(seed_mask)
+    # dense one-hot seed, not a (batch, row) coordinate scatter: a 2-D
+    # scatter serializes on TPU and is forbidden in the hot paths
+    # (analysis/invariants.py no-2d-scatter)
+    reach0 = jax.nn.one_hot(seed_rows, num_nodes,
+                            dtype=jnp.float32) * seed_mask[:, None]
 
     def step(reach, _):
         # expand: for every edge u->v, v becomes reachable if u is

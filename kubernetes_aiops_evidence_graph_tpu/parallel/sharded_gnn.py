@@ -39,6 +39,8 @@ by tests/test_parallel.py.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -211,7 +213,10 @@ def make_sharded_train_step(mesh: Mesh, tx, halo: str = "allgather",
     def loss_scalar(params, *arrs):
         return sharded_loss(params, *arrs).mean()
 
-    @jax.jit
+    # same donation discipline as the single-device step (rca/gnn.py):
+    # params/opt_state are rebound every call, graph/incident arrays are
+    # not — donate exactly the consumed pair
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, feats, kind, nmask, esrc, edst, erel,
              emask, inc_nodes, inc_mask, labels):
         loss, grads = jax.value_and_grad(loss_scalar)(

@@ -159,7 +159,7 @@ def evaluate(p: PolicyInput) -> PolicyResult:
             requires_approval=requires_approval(p),
             deny_reasons=reasons,
         )
-    except Exception as exc:  # fail closed (opa_client.py:79-87)
+    except Exception as exc:  # graft-audit: allow[broad-except] fail closed (opa_client.py:79-87): any evaluation error denies
         return PolicyResult(
             allow=False, requires_approval=True,
             deny_reasons=[f"policy evaluation error: {exc}"])

@@ -17,6 +17,7 @@ import weakref
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..config import Settings
@@ -71,9 +72,12 @@ def blast_propagation(
         iterations=iterations, alpha=alpha)
 
     # rank only nodes inside the k-hop blast set; drop pads and the seed.
-    # np.array (not asarray): on CPU backends jnp->np is a zero-copy
-    # read-only view, and we mutate ranked[seed] below.
-    ranked = np.array(scores * reach * jnp.asarray(snap.node_mask))
+    # ONE explicit fetch for both outputs (implicit np.asarray syncs are
+    # a host-sync lint violation); np.array copies because we mutate
+    # ranked[seed] below and device_get may return a read-only view.
+    reach_masked = reach * jnp.asarray(snap.node_mask)
+    ranked, reach_host = jax.device_get((scores * reach_masked, reach_masked))
+    ranked = np.array(ranked)
     ranked[seed] = 0.0
     order = np.argsort(-ranked, kind="stable")
     blast = []
@@ -86,7 +90,7 @@ def blast_propagation(
             "type": node["type"] if node else "?",
             "score": round(float(ranked[i]), 6),
         })
-    n_reached = int(np.asarray(reach * jnp.asarray(snap.node_mask)).sum()) - 1
+    n_reached = int(reach_host.sum()) - 1
     return {
         "incident": nid,
         "hops": hops,

@@ -239,7 +239,11 @@ def make_train_step(tx):
     (graph/snapshot.py REL_SLICE_BUCKETS) keeps the distinct-tuple count
     — and so the compile count — small across episodes."""
 
-    @partial(jax.jit, static_argnames=("rel_offsets", "slices_sorted"))
+    # params/opt_state are consumed and rebound every step: donating them
+    # lets XLA update in place (no-op on CPU, halves optimizer-state HBM
+    # traffic on device). Declared in analysis/ast_lint.JIT_DECLARATIONS.
+    @partial(jax.jit, static_argnames=("rel_offsets", "slices_sorted"),
+             donate_argnums=(0, 1))
     def step(params, opt_state, batch, rel_offsets=None,
              slices_sorted: bool = False):
         loss, grads = jax.value_and_grad(loss_fn)(

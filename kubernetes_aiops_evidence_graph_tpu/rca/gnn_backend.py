@@ -78,7 +78,7 @@ class GnnRcaBackend:
         b = gnn.snapshot_batch(snapshot)
         logits = gnn.forward_batch(self.params, b, bucketed=self._bucketed,
                                    compute_dtype=self._compute_dtype)
-        probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+        probs = np.asarray(jax.device_get(jax.nn.softmax(logits, axis=-1)))
         n = snapshot.num_incidents
         pred = probs.argmax(axis=-1)
         return {

@@ -470,7 +470,7 @@ class GnnStreamingScorer(StreamingScorer):
         super().warm_serving()
         try:
             self.warm_gnn()
-        except Exception as exc:
+        except Exception as exc:  # graft-audit: allow[broad-except] best-effort warm: serving stays correct, just pays the compile
             log.warning("warm_gnn_failed", error=str(exc))
 
     # -- introspection (tests) ---------------------------------------------

@@ -82,7 +82,7 @@ class LLMSummarizer:
                     h.description = parsed["enhanced_description"]
                 h.why_not_notes = parsed.get("alternatives") or h.why_not_notes
                 h.generated_by = HypothesisSource.HYBRID
-            except Exception as exc:  # fall back silently (activities.py:144-152)
+            except Exception as exc:  # graft-audit: allow[broad-except] fall back silently (activities.py:144-152)
                 log.warning("llm_enhancement_failed", hypothesis=h.rule_id,
                             error=str(exc))
         return out

@@ -1033,7 +1033,7 @@ class StreamingScorer:
         try:
             self.warm(delta_sizes=(64, 256), row_sizes=(4, 16),
                       include_next_width=True)
-        except Exception as exc:
+        except Exception as exc:  # graft-audit: allow[broad-except] best-effort warm: a failed pre-compile only costs a later compile
             log.warning("warm_serving_failed", error=str(exc))
         self._rearm_warm_growth()
 
@@ -1041,7 +1041,7 @@ class StreamingScorer:
         while True:
             try:
                 self.warm_growth()
-            except Exception as exc:  # a failed pre-compile only means the
+            except Exception as exc:  # graft-audit: allow[broad-except] a failed pre-compile only means the
                 log.warning(          # next rebuild pays the compile itself
                     "warm_growth_failed", error=str(exc))
             with self._warm_lock:

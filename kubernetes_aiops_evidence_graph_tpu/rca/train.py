@@ -196,8 +196,12 @@ def _predictions(params: gnn.Params, batches: Sequence[dict]
     # layouts (per-slice sorted fast path) and the reference elsewhere
     y_true, y_pred = [], []
     for b in batches:
-        logits = gnn.forward_batch(params, b)
-        pred = np.asarray(logits.argmax(axis=-1))
+        # exactly ONE explicit host transfer per batch: everything
+        # downstream (argmax, masking, the confusion matrix's .tolist())
+        # is host numpy, so the whole eval path is clean under the
+        # transfer-guard fixture (tests/test_graft_audit.py)
+        logits = jax.device_get(gnn.forward_batch(params, b))
+        pred = logits.argmax(axis=-1)
         mask = np.asarray(b["label_mask"]) > 0
         y_true.append(np.asarray(b["labels"])[mask])
         y_pred.append(pred[mask])
@@ -385,7 +389,7 @@ def crosscheck_holdout(params: gnn.Params,
             raise ValueError(
                 "crosscheck_holdout needs batches built with "
                 "return_snapshot=True (the oracle scores the snapshot)")
-        logits = np.asarray(gnn.forward_batch(params, b))
+        logits = jax.device_get(gnn.forward_batch(params, b))
         pred = logits.argmax(-1)
         raw = backend.score_snapshot(b["snapshot"])
         oracle = np.asarray(raw["top_rule_index"])

@@ -55,7 +55,7 @@ class RemediationExecutor:
                              else ActionStatus.FAILED)
             if not result.get("ok"):
                 action.error_message = result.get("error", "action failed")
-        except Exception as exc:
+        except Exception as exc:  # graft-audit: allow[broad-except] action-handler isolation: any failure marks the action FAILED
             action.status = ActionStatus.FAILED
             action.error_message = str(exc)
         action.completed_at = utcnow()

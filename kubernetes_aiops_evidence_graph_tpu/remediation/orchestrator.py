@@ -84,7 +84,7 @@ class RemediationOrchestrator:
                 final_score=round(final, 2),
                 is_acceptable=final < self.settings.remediation_max_blast_radius,
             )
-        except Exception as exc:  # max score on error (:102-108)
+        except Exception as exc:  # graft-audit: allow[broad-except] max score on error (:102-108): assessment fails closed
             return BlastRadiusAssessment(
                 target_namespace=incident.namespace,
                 final_score=100.0,

@@ -9,6 +9,7 @@ LiveClusterBackend transport (bearer-token K8s API over stdlib HTTP).
 """
 from __future__ import annotations
 
+import http.client
 import json
 import urllib.request
 from typing import Any
@@ -116,7 +117,7 @@ class LiveFaultInjector:
             with urllib.request.urlopen(req, timeout=b.timeout_s,
                                         context=b._ctx) as resp:
                 return 200 <= resp.status < 300
-        except Exception:
+        except (OSError, http.client.HTTPException):
             return False
 
     def create(self, scenario: str, namespace: str = "default") -> list[str]:
@@ -142,7 +143,7 @@ class LiveFaultInjector:
             try:
                 data = self.backend._get(self.backend.k8s_url, coll,
                                          {"labelSelector": selector}, bearer=True)
-            except Exception:
+            except (OSError, ValueError, http.client.HTTPException):
                 continue
             for item in data.get("items", []):
                 name = item["metadata"]["name"]
@@ -160,7 +161,7 @@ class LiveFaultInjector:
             try:
                 data = self.backend._get(self.backend.k8s_url, coll,
                                          {"labelSelector": selector}, bearer=True)
-            except Exception:
+            except (OSError, ValueError, http.client.HTTPException):
                 continue
             out += [f"{kind}/{i['metadata']['name']}" for i in data.get("items", [])]
         return out

@@ -122,7 +122,7 @@ class IncidentWorker:
                     settings=self.settings, engine=self.engine,
                     dedup=self.dedup, scorer=scorer)
                 self.completed += 1
-            except Exception as exc:
+            except Exception as exc:  # graft-audit: allow[broad-except] per-incident isolation: one failed workflow must not kill the serve loop
                 self.failed += 1
                 log.error("incident_workflow_error", slot=slot,
                           incident=str(incident.id), error=str(exc))

@@ -1,0 +1,6 @@
+"""Seeded violation: wall-clock duration measurement."""
+import time
+
+
+def span(start):
+    return time.time() - start        # wall-clock: not monotonic under NTP

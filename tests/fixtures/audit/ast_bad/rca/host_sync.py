@@ -1,0 +1,7 @@
+"""Seeded violation: implicit device->host sync in a hot module."""
+import jax.numpy as jnp
+
+
+def fetch_score(x):
+    logits = jnp.dot(x, x)
+    return float(logits)              # host-sync: implicit transfer

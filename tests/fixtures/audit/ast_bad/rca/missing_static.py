@@ -1,0 +1,10 @@
+"""Seeded violation: int-annotated jit parameter missing from static_argnames."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("hops",))
+def reach(x, hops: int, width: int):  # missing-static: width is traced
+    del hops
+    return x[:width]

@@ -1,0 +1,10 @@
+"""Seeded violation: host numpy call inside jitted code."""
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def center(x):
+    mu = np.mean(x)                   # np-in-traced: host eval per trace
+    return x - mu

@@ -1,0 +1,9 @@
+"""Seeded violation: Python branch on a traced value inside jitted code."""
+import jax
+
+
+@jax.jit
+def gate(x, limit):
+    if x > limit:                     # tracer-branch: freezes one trace
+        return x * 2
+    return x

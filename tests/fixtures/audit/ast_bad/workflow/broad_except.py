@@ -1,0 +1,8 @@
+"""Seeded violation: unwaived broad except that swallows all errors."""
+
+
+def drain(queue):
+    try:
+        return queue.pop()
+    except Exception:                 # broad-except: no waiver pragma
+        return None

@@ -1,0 +1,36 @@
+"""Patterns the lint must NOT flag (false-positive pins) plus one waived
+site (waiver accounting pin)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def normalize(x, eps=None):
+    if eps is None:                   # `is None` test is static: exempt
+        eps = 1e-6
+    if x.ndim == 2:                   # shape/rank attribute is static: exempt
+        x = x.reshape(-1)
+    return x / (jnp.abs(x).max() + eps)
+
+
+def fetch(x):
+    y = jnp.dot(x, x)
+    host = jax.device_get(y)          # the sanctioned explicit transfer
+    return float(host)
+
+
+def mesh_shape():
+    return len(jax.devices())         # host objects, not device arrays
+
+
+def guarded(queue):
+    try:
+        return queue.pop()
+    except Exception:  # graft-audit: allow[broad-except] fixture: intentional isolation boundary
+        return None
+
+
+def elapsed(start):
+    return time.monotonic() - start

@@ -1,0 +1,20 @@
+"""Seeded jaxpr violation: bf16 matmul operands accumulating into bf16
+(must accumulate into f32 via preferred_element_type)."""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import Entrypoint
+
+
+def _build():
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+
+    return f, (np.zeros((128, 64), np.float32),
+               np.zeros((64, 64), np.float32))
+
+
+ENTRYPOINTS = (Entrypoint(
+    "fixture.bf16.accum", _build, InvariantSpec(bf16_accum_f32=True)),)

@@ -1,0 +1,23 @@
+"""Seeded jaxpr violation: an [N, R, H]-scale dense materialization that
+blows the per-intermediate byte budget (the exact PR 1 regression shape)."""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import Entrypoint
+
+_N, _R, _H = 4096, 9, 64              # [N, R, H] f32 = 9.4 MB > 4 MiB budget
+
+
+def _build():
+    import jax.numpy as jnp
+
+    def f(h, w):
+        return jnp.einsum("nh,rhk->nrk", h, w).sum(axis=1)
+
+    return f, (np.zeros((_N, _H), np.float32),
+               np.zeros((_R, _H, _H), np.float32))
+
+
+ENTRYPOINTS = (Entrypoint(
+    "fixture.bytes.nrh", _build,
+    InvariantSpec(max_intermediate_bytes=4 * (1 << 20))),)

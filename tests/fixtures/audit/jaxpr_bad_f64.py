@@ -1,0 +1,23 @@
+"""Seeded jaxpr violation: f64 creep. Enabling x64 at import mirrors an
+accidental global jax_enable_x64 flip in production code — run this module
+in its own process (the config change is global)."""
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import Entrypoint
+
+
+def _build():
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.cumsum(x.astype(jnp.float64))   # f64 intermediate
+
+    return f, (np.zeros(128, np.float32),)
+
+
+ENTRYPOINTS = (Entrypoint("fixture.f64.creep", _build, InvariantSpec()),)

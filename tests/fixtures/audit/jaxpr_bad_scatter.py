@@ -1,0 +1,30 @@
+"""Seeded jaxpr violations: a set-scatter in a scatter-forbidden path and
+a 2-D scatter (the TPU-serializing shape PR 1 measured at 9.4x slower)."""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+    NO_SET_SCATTER, Entrypoint)
+
+
+def _build_set():
+    def f(h, idx, v):
+        return h.at[idx].set(v)       # 1-D set-scatter: forbidden primitive
+
+    return f, (np.zeros((64, 8), np.float32), np.zeros(16, np.int32),
+               np.zeros((16, 8), np.float32))
+
+
+def _build_2d():
+    def f(h, rows, cols, v):
+        return h.at[rows, cols].add(v)   # 2-D scatter-add: serializes on TPU
+
+    return f, (np.zeros((64, 8), np.float32), np.zeros(16, np.int32),
+               np.zeros(16, np.int32), np.zeros(16, np.float32))
+
+
+ENTRYPOINTS = (
+    Entrypoint("fixture.scatter.set", _build_set,
+               InvariantSpec(forbid_primitives=NO_SET_SCATTER)),
+    Entrypoint("fixture.scatter.2d", _build_2d, InvariantSpec()),
+)

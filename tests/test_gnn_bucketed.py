@@ -77,8 +77,11 @@ def test_train_step_through_bucketed_kernel(world_batch):
     tx = optax.adam(1e-2)
     step = gnn.make_train_step(tx)
 
-    p_ref, p_buck = params, params
-    s_ref, s_buck = tx.init(params), tx.init(params)
+    # the step donates (params, opt_state): give each trajectory its own
+    # copy so the module-scoped fixture's params survive
+    copy = lambda t: jax.tree_util.tree_map(lambda x: jax.numpy.array(x), t)
+    p_ref, p_buck = copy(params), copy(params)
+    s_ref, s_buck = tx.init(p_ref), tx.init(p_buck)
     for _ in range(5):
         p_ref, s_ref, l_ref = step(p_ref, s_ref, batch)
         p_buck, s_buck, l_buck = step(

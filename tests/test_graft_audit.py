@@ -1,0 +1,226 @@
+"""graft-audit: the analyzer's own tests (marker ``static_audit``).
+
+Three layers, mirroring the three passes:
+
+* seeded-violation fixtures under tests/fixtures/audit — each must
+  produce EXACTLY its expected finding (and the clean tree none), and the
+  CLI must exit non-zero on every bad fixture;
+* the self-audit — the repo itself must be clean, and the registry must
+  keep the scatter-free / no-f64 / byte-budget invariants pinned on every
+  GNN hot-path entrypoint;
+* pass-3 runtime guards — the streaming-churn workload must stay inside
+  the delta-ladder retrace budget (recompilation-hazard detection), and
+  the serving fetch path must be clean under a device→host transfer
+  guard (a no-op on the CPU backend, where the AST host-sync rule is the
+  backstop — the guard bites on real accelerators).
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.analysis import run_audit
+from kubernetes_aiops_evidence_graph_tpu.analysis.__main__ import main as audit_main
+from kubernetes_aiops_evidence_graph_tpu.analysis.ast_lint import (
+    JIT_DECLARATIONS, lint_tree)
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+    ENTRYPOINTS, HOT_BUDGET)
+from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+    CompileCounter, ladder_retrace_budget, no_implicit_transfers)
+
+pytestmark = pytest.mark.static_audit
+
+FIXTURES = Path(__file__).parent / "fixtures" / "audit"
+
+# every seeded AST fixture file and the ONE rule it must trip
+AST_EXPECTED = {
+    "rca/tracer_branch.py": "tracer-branch",
+    "rca/host_sync.py": "host-sync",
+    "rca/missing_static.py": "missing-static",
+    "rca/np_traced.py": "np-in-traced",
+    "workflow/broad_except.py": "broad-except",
+    "observability/wall_clock.py": "wall-clock",
+}
+
+# every seeded jaxpr fixture module and the rule set it must trip
+JAXPR_EXPECTED = {
+    "jaxpr_bad_scatter": {"forbidden-primitive", "no-2d-scatter"},
+    "jaxpr_bad_f64": {"no-f64"},
+    "jaxpr_bad_bytes": {"byte-budget"},
+    "jaxpr_bad_bf16": {"bf16-accum"},
+}
+
+
+# -- pass 2: seeded AST fixtures ------------------------------------------
+
+def test_ast_fixtures_each_produce_exactly_the_expected_finding():
+    report = lint_tree(FIXTURES / "ast_bad")
+    got = {(f.where.rsplit(":", 1)[0], f.rule) for f in report.violations}
+    assert got == set(AST_EXPECTED.items())
+    # exactly one finding per seeded file — no collateral noise
+    assert len(report.violations) == len(AST_EXPECTED)
+    assert not report.waivers
+
+
+def test_ast_clean_tree_has_no_violations_and_counts_the_waiver():
+    report = lint_tree(FIXTURES / "ast_clean")
+    assert report.violations == []
+    assert len(report.waivers) == 1
+    assert report.waivers[0].rule == "broad-except"
+    assert "isolation" in report.waivers[0].waiver_reason
+
+
+def test_cli_exits_nonzero_on_bad_tree_and_zero_on_clean(capsys):
+    assert audit_main(["--root", str(FIXTURES / "ast_bad")]) == 1
+    assert audit_main(["--root", str(FIXTURES / "ast_clean")]) == 0
+    capsys.readouterr()
+
+
+# -- pass 1: seeded jaxpr fixtures (subprocess: the f64 fixture flips
+#    global x64 config, and the CLI's virtual-mesh setup is import-time) --
+
+@pytest.mark.parametrize("module", sorted(JAXPR_EXPECTED))
+def test_cli_exits_nonzero_on_each_seeded_jaxpr_fixture(module):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(FIXTURES), env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_aiops_evidence_graph_tpu.analysis",
+         "--skip-ast", "--jaxpr-fixture", module, "--report", "json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 1, proc.stderr
+    import json
+    report = json.loads(proc.stdout)
+    assert {v["rule"] for v in report["violations"]} == JAXPR_EXPECTED[module]
+
+
+# -- self-audit: the repo is clean, the invariants stay pinned ------------
+
+def test_self_audit_repo_is_clean():
+    report = run_audit()
+    assert report.violations == [], report.to_text()
+    # the audit actually ran: every registered entrypoint was visited
+    assert len(report.entrypoints_audited) == len(ENTRYPOINTS)
+
+
+def test_registry_pins_gnn_hot_path_invariants():
+    """Acceptance pin: scatter-free / no-f64 / byte-budget on all
+    registered GNN hot-path entrypoints."""
+    by_name = {e.name: e for e in ENTRYPOINTS}
+    gnn_hot = [n for n in by_name
+               if n.startswith(("gnn.", "sharded_gnn.", "streaming.gnn_tick",
+                                "ops.gather_matmul_segment"))]
+    assert len(gnn_hot) >= 7
+    for name in gnn_hot:
+        spec = by_name[name].spec
+        assert spec.forbid_f64, name
+        assert spec.forbid_2d_scatter, name
+        assert spec.max_intermediate_bytes is not None, name
+    # the bucketed forward paths additionally forbid set-scatters outright
+    for name in ("gnn.forward.bucketed", "gnn.forward.bucketed.bf16",
+                 "ops.gather_matmul_segment", "ops.gather_matmul_segment.bf16"):
+        assert "scatter" in by_name[name].spec.forbid_primitives, name
+    # bf16 paths must pin f32 accumulation
+    for name in ("gnn.forward.bucketed.bf16", "ops.gather_matmul_segment.bf16"):
+        assert by_name[name].spec.bf16_accum_f32, name
+    # new jit sites must register their signatures (completeness contract)
+    assert ("rca/gnn.py", "forward") in JIT_DECLARATIONS
+    assert ("rca/gnn.py", "step") in JIT_DECLARATIONS
+    assert HOT_BUDGET < 40 * (1 << 20)
+
+
+# -- pass 3: runtime guards on the streaming-churn workload ---------------
+
+@pytest.fixture(scope="module")
+def params():
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+        _shipped_checkpoint)
+    path = _shipped_checkpoint()
+    if path is None:
+        pytest.skip("shipped GNN checkpoint not present")
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import load_checkpoint
+    return load_checkpoint(path)["params"]
+
+
+def _churn_world(params, n_events, seed):
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+        GnnStreamingScorer)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, stream_step)
+    from tests.test_streaming import SMALL, _world
+    cluster, builder, _ = _world(num_pods=120)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    events = list(churn_events(
+        cluster, n_events, seed=seed,
+        incident_ids=tuple(builder.store.incident_ids())))
+    return cluster, builder, scorer, events, stream_step
+
+
+def test_streaming_churn_stays_inside_the_retrace_ladder(params, monkeypatch):
+    """Recompilation-hazard detection: under edge/feature churn the GNN
+    tick may retrace only for (a) distinct delta-ladder static keys and
+    (b) re-mirrors that re-bucket the resident edge arrays — more
+    compiles than that means something non-static leaked into the trace."""
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn_streaming
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import _DELTA_BUCKETS
+
+    cluster, builder, scorer, events, stream_step = _churn_world(
+        params, n_events=300, seed=29)
+
+    real = gnn_streaming._gnn_tick
+    counter = CompileCounter(real)
+    pe_shapes: set[int] = set()
+
+    def wrapped(p, feats, kind, nmask, esrc, *rest, **kw):
+        pe_shapes.add(int(esrc.shape[0]))
+        counter.record(**kw)
+        return real(p, feats, kind, nmask, esrc, *rest, **kw)
+
+    monkeypatch.setattr(gnn_streaming, "_gnn_tick", wrapped)
+    for i, ev in enumerate(events):
+        stream_step(cluster, builder.store, scorer, ev)
+        if (i + 1) % 40 == 0:
+            scorer.dispatch()
+    scorer.dispatch()
+
+    assert counter.keys_seen, "tick never ran under churn"
+    for key in counter.keys_seen:
+        statics = dict(key)
+        assert statics["pk"] in _DELTA_BUCKETS, statics
+        assert statics["ek"] in _DELTA_BUCKETS, statics
+        assert statics["slices_sorted"] is False, \
+            "the churn mirror must never promise within-slice dst order"
+    permitted = ladder_retrace_budget(_DELTA_BUCKETS) * max(len(pe_shapes), 1)
+    assert not counter.over_budget(permitted), counter.summary()
+
+
+def test_serving_fetch_path_is_clean_under_transfer_guard(params):
+    """The rescore fetch path performs only EXPLICIT device→host
+    transfers (jax.device_get). The tick's per-dispatch delta upload is an
+    intentional host→device feed, so only d2h is disallowed here."""
+    cluster, builder, scorer, events, stream_step = _churn_world(
+        params, n_events=60, seed=31)
+    for ev in events:
+        stream_step(cluster, builder.store, scorer, ev)
+    with no_implicit_transfers(host_to_device=False):
+        out = scorer.rescore()
+    assert out["probs"].shape[0] == len(out["incident_ids"])
+    assert np.isfinite(out["probs"]).all()
+
+
+def test_train_eval_path_is_clean_under_transfer_guard(params):
+    """Satellite pin: the confusion-matrix path in rca/train.py fetches
+    once via jax.device_get — the whole eval is host numpy after that."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import build_snapshot
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import _predictions
+    from tests.test_streaming import SMALL, _world
+    _, builder, _ = _world(num_pods=60)
+    snap = build_snapshot(builder.store, SMALL)
+    batch = gnn.snapshot_batch(snap)   # carries labels + label_mask
+    with no_implicit_transfers(host_to_device=False):
+        y_true, y_pred = _predictions(params, [batch])
+    assert y_true.shape == y_pred.shape
