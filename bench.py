@@ -78,6 +78,35 @@ def build_world(num_pods: int, num_incidents: int, seed: int = 0):
 _ANCHORS: dict = {}
 
 
+def _static_cost_record() -> dict:
+    """One JSON record of the STATIC cost model at the canonical registry
+    shapes — the same numbers the graft-cost ratchet pins in
+    COST_BASELINE.json, so the bench output and the CI gate can never
+    drift apart (the shapes are imported, not re-declared)."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_entrypoint)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        ENTRYPOINTS, HIDDEN, N_NODES, REL_COUNTS)
+    by_name = {e.name: e for e in ENTRYPOINTS}
+    rec = {
+        "metric": "static_cost_model_canonical",
+        "unit": "modeled_MB_per_forward",
+        "vs_baseline": 1.0,
+        "shapes": {"n_nodes": N_NODES, "hidden": HIDDEN,
+                   "rel_counts": list(REL_COUNTS)},
+    }
+    for key, name in (("forward", "gnn.forward.bucketed"),
+                      ("gms", "ops.gather_matmul_segment")):
+        c = cost_entrypoint(by_name[name])
+        rec[f"{key}_modeled_mflop"] = round(c.flops / 1e6, 1)
+        rec[f"{key}_modeled_hbm_mb"] = round(c.hbm_bytes / 1e6, 1)
+        rec[f"{key}_modeled_peak_mb"] = round(
+            c.peak_intermediate_bytes / 1e6, 1)
+        rec[f"{key}_modeled_ai"] = round(c.arithmetic_intensity, 2)
+    rec["value"] = rec["forward_modeled_hbm_mb"]
+    return rec
+
+
 def device_anchors() -> dict:
     """Measured per-process hardware anchors: achievable HBM GB/s and bf16
     TFLOP/s (rca/device_metrics.py scanned-slope method), plus the
@@ -653,6 +682,25 @@ def _gnn_and_trace_records(snapshot) -> None:
         per_layer_s = buck_s / (layers + 1)
         roof = dm.roofline_record(acct["bytes"], acct["flops"], per_layer_s,
                                   anchors["hbm_gbps"], anchors["bf16_tflops"])
+        # measured-vs-MODELED roofline: trace the exact forward this bench
+        # ran (same batch shapes) and price it with the graft-cost static
+        # model — the same walker the CI ratchet uses, so the bench's
+        # roofline story and the analyzer's can never disagree
+        from functools import partial as _partial
+
+        from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+            cost_jaxpr)
+        offs = tuple(b.get("rel_offsets") or ())
+        fwd = _partial(gnn.forward, rel_offsets=offs,
+                       slices_sorted=gnn.slices_sorted_by_dst(
+                           b["edge_dst"], offs))
+        cost = cost_jaxpr("gnn.forward.bucketed@bench", jax.make_jaxpr(fwd)(
+            be.params, b["features"], b["node_kind"], b["node_mask"],
+            b["edge_src"], b["edge_dst"], b["edge_rel"], b["edge_mask"],
+            b["incident_nodes"]))
+        modeled_floor_s = max(
+            cost.hbm_bytes / (anchors["hbm_gbps"] * 1e9),
+            cost.flops / (anchors["bf16_tflops"] * 1e12))
         print(json.dumps({
             "metric": "gnn_forward_50knodes_500incidents",
             "value": round(buck_s * 1e3, 3),
@@ -666,6 +714,11 @@ def _gnn_and_trace_records(snapshot) -> None:
             "parity_max_abs_logit_diff": parity,
             "hidden": hidden, "layers": layers,
             "per_layer_ms": round(per_layer_s * 1e3, 4),
+            "modeled_mflop": round(cost.flops / 1e6, 1),
+            "modeled_hbm_mb": round(cost.hbm_bytes / 1e6, 1),
+            "modeled_ai": round(cost.arithmetic_intensity, 2),
+            "modeled_floor_ms": round(modeled_floor_s * 1e3, 3),
+            "measured_vs_modeled": round(buck_s / modeled_floor_s, 2),
             **roof,
         }), flush=True)
     except (Exception, SystemExit) as exc:
@@ -720,6 +773,15 @@ def main(argv=None) -> int:
     platform = ensure_responsive_device()
     if args.calibrate and platform == "tpu":
         _calibrate_slope()
+
+    # static measured-free cost record first (deterministic, no device
+    # time): a failure must never block the measured configs
+    try:
+        print(json.dumps(_static_cost_record()), flush=True)
+    except (Exception, SystemExit) as exc:
+        print(json.dumps({"metric": "static_cost_model_canonical",
+                          "value": 0, "unit": "error", "vs_baseline": 0,
+                          "error": str(exc)}), flush=True)
 
     if args.smoke:
         speedup, _, _, _, extras = bench_rca(200, 10, 10, args.iters)

@@ -28,10 +28,21 @@ Three passes:
 * **Pass 3 — runtime guards** (`runtime_guards`): pytest-side transfer
   guards + a compilation counter for recompilation-hazard detection on
   the streaming-churn workload (see tests/test_graft_audit.py).
+* **Pass 4 — graft-cost** (`cost_model`, `comms`, `baseline`): the
+  QUANTITATIVE dimension — a static roofline model per entrypoint
+  (per-primitive FLOPs, HBM read/write bytes from operand/result avals,
+  peak live-intermediate bytes, arithmetic intensity), a collective
+  census checked against each entrypoint's declared
+  :class:`~.comms.CostSpec` (the ring halo must stream [N/D, H]
+  ``ppermute`` blocks and contain zero full-[N, H] all-gathers), and a
+  ratchet against the committed ``COST_BASELINE.json`` (+2% FLOPs / +5%
+  bytes tolerance; ``--update-baseline`` re-records, ``# graft-audit:
+  allow[cost]`` waives an intentional regression).
 
 CLI: ``python -m kubernetes_aiops_evidence_graph_tpu.analysis --report
-json`` exits non-zero on violations. This package must stay import-light
-(no jax at import time) — pass 1 pulls jax lazily.
+json`` exits non-zero on violations; add ``--cost`` for the ratchet.
+This package must stay import-light (no jax at import time) — passes 1
+and 4 pull jax lazily.
 """
 from __future__ import annotations
 
@@ -40,12 +51,14 @@ from .findings import Finding, Report
 __all__ = ["Finding", "Report", "run_audit"]
 
 
-def run_audit(root=None, jaxpr: bool = True, ast: bool = True) -> Report:
+def run_audit(root=None, jaxpr: bool = True, ast: bool = True,
+              cost: bool = False) -> Report:
     """Run the static passes and return a combined Report.
 
     ``root`` overrides the source tree for the AST pass (fixture trees in
     tests); the jaxpr pass always audits the installed package's
-    registered entrypoints.
+    registered entrypoints. ``cost=True`` adds the graft-cost pass
+    against the committed COST_BASELINE.json.
     """
     report = Report()
     if jaxpr:
@@ -54,4 +67,9 @@ def run_audit(root=None, jaxpr: bool = True, ast: bool = True) -> Report:
     if ast:
         from .ast_lint import lint_tree
         report.extend(lint_tree(root))
+    if cost:
+        from .baseline import run_cost_pass
+        findings, section = run_cost_pass()
+        report.extend(findings)
+        report.cost = section
     return report

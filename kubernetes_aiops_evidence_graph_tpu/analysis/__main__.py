@@ -1,6 +1,8 @@
 """graft-audit CLI.
 
     python -m kubernetes_aiops_evidence_graph_tpu.analysis [--report json]
+    python -m kubernetes_aiops_evidence_graph_tpu.analysis --cost
+    python -m kubernetes_aiops_evidence_graph_tpu.analysis --update-baseline
 
 Exit status 0 = zero unwaived violations; 1 = violations found. The
 jaxpr pass traces the registered hot-path entrypoints (including both
@@ -8,9 +10,17 @@ sharded halo strategies, which need a multi-device mesh — a virtual
 8-device CPU mesh is forced below when jax is not yet imported); the AST
 pass lints the package source (or ``--root`` for fixture trees).
 
+``--cost`` adds the graft-cost pass: a static roofline model (FLOPs, HBM
+bytes, peak live-intermediate bytes, arithmetic intensity) plus a
+collective-traffic census per entrypoint, ratcheted against the
+committed ``COST_BASELINE.json`` (+2% FLOPs / +5% bytes tolerance; see
+analysis/baseline.py). ``--update-baseline`` re-records the baseline
+instead of ratcheting — commit the JSON diff for review.
+
 ``--jaxpr-fixture dotted.module`` audits a module exposing an
 ``ENTRYPOINTS`` tuple instead of the built-in registry — how the
-seeded-violation fixtures under tests/fixtures/audit are driven.
+seeded-violation fixtures under tests/fixtures/audit are driven (with
+``--cost-baseline`` pointing at a fixture baseline for the cost pass).
 """
 from __future__ import annotations
 
@@ -46,27 +56,48 @@ def main(argv: "list[str] | None" = None) -> int:
                          "instead of the built-in registry")
     ap.add_argument("--skip-jaxpr", action="store_true")
     ap.add_argument("--skip-ast", action="store_true")
+    ap.add_argument("--cost", action="store_true",
+                    help="run the graft-cost pass (static roofline + "
+                         "collective census, ratcheted against "
+                         "COST_BASELINE.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="re-record COST_BASELINE.json from the current "
+                         "traces instead of ratcheting (implies --cost)")
+    ap.add_argument("--cost-baseline", default=None,
+                    help="override the baseline JSON path (fixture mode)")
     args = ap.parse_args(argv)
+    if args.update_baseline:
+        args.cost = True
 
     from .findings import Report
     report = Report()
 
     run_jaxpr = not args.skip_jaxpr and (args.root is None
                                          or args.jaxpr_fixture)
-    if run_jaxpr:
+    entry_module = None
+    if run_jaxpr or args.cost:
         _force_virtual_mesh()
         import jax
         jax.config.update("jax_platforms", "cpu")
-        from .jaxpr_audit import audit_entrypoints
         if args.jaxpr_fixture:
-            mod = importlib.import_module(args.jaxpr_fixture)
-            report.extend(audit_entrypoints(mod.ENTRYPOINTS))
+            entry_module = importlib.import_module(args.jaxpr_fixture)
+    if run_jaxpr:
+        from .jaxpr_audit import audit_entrypoints
+        if entry_module is not None:
+            report.extend(audit_entrypoints(entry_module.ENTRYPOINTS))
         else:
             from .registry import ENTRYPOINTS
             report.extend(audit_entrypoints(ENTRYPOINTS))
     if not args.skip_ast:
         from .ast_lint import lint_tree
         report.extend(lint_tree(args.root))
+    if args.cost:
+        from .baseline import run_cost_pass
+        findings, section = run_cost_pass(
+            entry_module=entry_module, baseline_path=args.cost_baseline,
+            update=args.update_baseline)
+        report.extend(findings)
+        report.cost = section
 
     print(report.to_json() if args.report == "json" else report.to_text())
     return report.exit_code

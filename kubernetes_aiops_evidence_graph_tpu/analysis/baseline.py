@@ -1,0 +1,230 @@
+"""graft-cost — the ratcheted perf baseline.
+
+``COST_BASELINE.json`` (repo root, committed) records the modeled cost of
+every registered entrypoint at its canonical shapes: total FLOPs, HBM
+bytes, peak live-intermediate bytes, and total collective payload bytes.
+The check fails any entrypoint whose freshly-modeled numbers regress
+beyond tolerance:
+
+* FLOPs: **+2%** (``cost-flops``)
+* HBM bytes and peak intermediate bytes: **+5%** (``cost-bytes``)
+* collective payload bytes: **+5%** (``cost-collective-bytes``) — a zero
+  baseline means ANY new collective traffic fails, so a single-device
+  kernel cannot silently go distributed
+
+plus bookkeeping rules that keep the baseline honest: every registered
+(and traceable) entrypoint must have a baseline entry
+(``cost-baseline-missing``) and every baseline entry must still be
+registered (``cost-baseline-stale``). Improvements never fail — run
+``--update-baseline`` to ratchet them in (that is also the workflow for
+intentional regressions, reviewed via the diff of COST_BASELINE.json).
+
+Waivers: an intentional regression carries
+``# graft-audit: allow[cost] one-line reason`` on the line of (or
+adjacent to) the entrypoint's name in the module that registers it.
+Waived findings are counted and listed, never dropped — same policy as
+the AST lint.
+"""
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import replace
+from pathlib import Path
+
+from .ast_lint import _WAIVER_RE, package_root
+from .comms import check_collectives
+from .findings import Finding
+
+TOL_FLOPS = 0.02
+TOL_BYTES = 0.05
+
+# rules the allow[cost] pragma can waive
+COST_RULES = frozenset({
+    "cost-flops", "cost-bytes", "cost-collective-bytes",
+    "cost-baseline-missing", "forbidden-collective", "collective-count",
+    "collective-bytes",
+})
+
+_NAME_RE = re.compile(r'"([A-Za-z0-9_.\-]+)"')
+
+
+def default_baseline_path() -> Path:
+    return package_root().parent / "COST_BASELINE.json"
+
+
+def load_baseline(path: Path) -> dict:
+    """name -> baseline record; {} when the file does not exist yet."""
+    if not Path(path).exists():
+        return {}
+    return json.loads(Path(path).read_text()).get("entrypoints", {})
+
+
+def save_baseline(path: Path, entrypoints: dict) -> None:
+    doc = {
+        "tool": "graft-cost",
+        "tolerances": {"flops": TOL_FLOPS, "bytes": TOL_BYTES},
+        "entrypoints": {k: entrypoints[k] for k in sorted(entrypoints)},
+    }
+    Path(path).write_text(json.dumps(doc, indent=2) + "\n")
+
+
+def baseline_record(cost) -> dict:
+    """The ratcheted subset of an EntryCost (what the JSON commits)."""
+    return {
+        "flops": cost.flops,
+        "dot_flops": cost.dot_flops,
+        "hbm_bytes": cost.hbm_bytes,
+        "peak_intermediate_bytes": cost.peak_intermediate_bytes,
+        "collective_bytes": cost.collective_bytes,
+    }
+
+
+def cost_waivers(module, names) -> dict:
+    """``# graft-audit: allow[cost] reason`` pragmas next to entrypoint
+    registrations in ``module``'s source: name -> reason. The pragma must
+    sit on the line of the quoted entrypoint name or an adjacent line."""
+    try:
+        lines = Path(module.__file__).read_text().splitlines()
+    except (OSError, AttributeError):
+        return {}
+    pragmas: dict[int, str] = {}
+    for i, line in enumerate(lines):
+        m = _WAIVER_RE.search(line)
+        if m and "cost" in {r.strip() for r in m.group(1).split(",")}:
+            pragmas[i] = m.group(2).strip()
+    waivers: dict[str, str] = {}
+    if not pragmas:
+        return waivers
+    names = set(names)
+    for i, line in enumerate(lines):
+        for lit in _NAME_RE.findall(line):
+            if lit not in names:
+                continue
+            for j in (i - 1, i, i + 1):
+                if j in pragmas:
+                    waivers[lit] = pragmas[j]
+                    break
+    return waivers
+
+
+def _ratchet(name: str, label: str, rule: str, new: int, base: int,
+             tol: float) -> "Finding | None":
+    if new <= base * (1.0 + tol):
+        return None
+    pct = (new / base - 1.0) * 100 if base else float("inf")
+    grew = f"+{pct:.1f}%" if base else f"{new} B/FLOPs from a zero baseline"
+    return Finding(
+        rule=rule, where=name, pass_name="cost",
+        message=f"modeled {label} regressed: {new} vs baseline {base} "
+                f"({grew}, tolerance +{tol * 100:.0f}%) — re-measure and "
+                "run --update-baseline if intentional, or waive with "
+                "# graft-audit: allow[cost]")
+
+
+def check_against_baseline(costs: dict, baseline: dict,
+                           registered_names) -> list[Finding]:
+    """Ratchet every computed EntryCost against its baseline record."""
+    findings: list[Finding] = []
+    for name in sorted(costs):
+        cost = costs[name]
+        base = baseline.get(name)
+        if base is None:
+            findings.append(Finding(
+                rule="cost-baseline-missing", where=name, pass_name="cost",
+                message="no COST_BASELINE.json entry — run "
+                        "--update-baseline to record this entrypoint"))
+            continue
+        for f in (
+            _ratchet(name, "FLOPs", "cost-flops",
+                     cost.flops, base.get("flops", 0), TOL_FLOPS),
+            _ratchet(name, "HBM bytes", "cost-bytes",
+                     cost.hbm_bytes, base.get("hbm_bytes", 0), TOL_BYTES),
+            _ratchet(name, "peak intermediate bytes", "cost-bytes",
+                     cost.peak_intermediate_bytes,
+                     base.get("peak_intermediate_bytes", 0), TOL_BYTES),
+            _ratchet(name, "collective bytes", "cost-collective-bytes",
+                     cost.collective_bytes,
+                     base.get("collective_bytes", 0), TOL_BYTES),
+        ):
+            if f is not None:
+                findings.append(f)
+    registered = set(registered_names)
+    for name in sorted(set(baseline) - registered):
+        findings.append(Finding(
+            rule="cost-baseline-stale", where=name, pass_name="cost",
+            message="baseline entry no longer matches any registered "
+                    "entrypoint — run --update-baseline to drop it"))
+    return findings
+
+
+def _vs_baseline(cost, base: "dict | None") -> dict:
+    if not base:
+        return {}
+    out = {}
+    for key, new in baseline_record(cost).items():
+        old = base.get(key, 0)
+        out[key] = round(new / old - 1.0, 4) if old else (0.0 if not new
+                                                          else None)
+    return out
+
+
+def run_cost_pass(entry_module=None, baseline_path=None,
+                  update: bool = False):
+    """Trace + cost + collective-check + ratchet the registered
+    entrypoints. Returns ``(findings, cost_section)`` where
+    ``cost_section`` is the JSON report's ``cost`` object.
+
+    ``entry_module`` defaults to the built-in registry; fixture modules
+    expose their own ``ENTRYPOINTS``. ``update=True`` rewrites the
+    baseline (preserving entries for skipped/untraceable entrypoints)
+    instead of ratcheting against it.
+    """
+    from .cost_model import cost_entrypoints
+    if entry_module is None:
+        from . import registry as entry_module
+    entrypoints = entry_module.ENTRYPOINTS
+    names = [e.name for e in entrypoints]
+
+    costs, findings, skipped = cost_entrypoints(entrypoints)
+    for entry in entrypoints:
+        if entry.name in costs:
+            findings.extend(check_collectives(
+                entry.name, costs[entry.name],
+                getattr(entry, "cost", None)))
+
+    path = Path(baseline_path) if baseline_path else default_baseline_path()
+    baseline = load_baseline(path)
+    if update:
+        merged = dict(baseline)
+        for name in set(merged) - set(names):
+            del merged[name]          # drop stale entries
+        skipped_names = {s.split(" ", 1)[0] for s in skipped}
+        for name in set(baseline) & skipped_names:
+            merged[name] = baseline[name]   # keep what we could not trace
+        for name, cost in costs.items():
+            merged[name] = baseline_record(cost)
+        save_baseline(path, merged)
+        baseline = merged
+    else:
+        findings.extend(check_against_baseline(costs, baseline, names))
+
+    waivers = cost_waivers(entry_module, names)
+    findings = [
+        replace(f, waived=True, waiver_reason=waivers[f.where])
+        if f.rule in COST_RULES and f.where in waivers else f
+        for f in findings
+    ]
+
+    section = {
+        "baseline": str(path),
+        "updated": bool(update),
+        "tolerances": {"flops": TOL_FLOPS, "bytes": TOL_BYTES},
+        "skipped": skipped,
+        "entrypoints": {
+            name: {**cost.to_dict(),
+                   "vs_baseline": _vs_baseline(cost, baseline.get(name))}
+            for name, cost in sorted(costs.items())
+        },
+    }
+    return findings, section

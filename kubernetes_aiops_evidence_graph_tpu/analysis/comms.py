@@ -1,0 +1,91 @@
+"""graft-cost — static collective-traffic contracts for sharded entrypoints.
+
+The sharded halo strategies (parallel/sharded_gnn.py) were designed
+around exact communication shapes: the ring path streams [N/D, H] blocks
+with ``ppermute`` and must NEVER materialize a full [N, H] all-gather;
+the allgather path performs exactly one all-gather per layer plus the
+readout. Nothing in the qualitative audit pins that — an edit could add
+a convenience ``all_gather`` to the ring loop and silently multiply
+halo-exchange bytes by D without tripping any invariant.
+
+Each registered entrypoint may carry a :class:`CostSpec` declaring its
+expected collective census (exact counts per primitive, named bans,
+per-op and total payload-byte ceilings). Entrypoints WITHOUT a spec get
+:data:`COST_DEFAULT` — a single-device kernel must contain no
+collectives at all. The census itself is computed by
+cost_model.cost_jaxpr (loop-weighted: the ring's per-layer ``fori_loop``
+lowers to a scan of length D, so its single traced ppermute counts D
+times).
+
+Rules: ``forbidden-collective`` (a banned primitive appears),
+``collective-count`` (census differs from the declared exact count),
+``collective-bytes`` (a single payload exceeds its per-op ceiling, or
+the total exceeds ``max_total_bytes``). All are waivable with
+``# graft-audit: allow[cost] reason`` next to the entrypoint
+registration (see baseline.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+# every cross-device communication primitive we census
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter",
+})
+
+
+@dataclass(frozen=True)
+class CostSpec:
+    """Declared collective-traffic contract for one entrypoint."""
+    # primitive -> EXACT loop-weighted count the trace must contain
+    expect_counts: dict = field(default_factory=dict)
+    # primitives that must not appear at all
+    forbid: tuple = ()
+    # primitive -> max payload bytes any single op may move
+    max_bytes_per_op: dict = field(default_factory=dict)
+    # ceiling on total collective payload bytes for the whole trace
+    max_total_bytes: "int | None" = None
+
+
+# single-device kernels: no collectives, full stop
+COST_DEFAULT = CostSpec(forbid=tuple(sorted(COLLECTIVE_PRIMS)))
+
+
+def check_collectives(name: str, cost, spec: "CostSpec | None") -> list[Finding]:
+    """Check one EntryCost's collective census against its CostSpec."""
+    spec = spec if spec is not None else COST_DEFAULT
+    findings: list[Finding] = []
+
+    def hit(rule: str, message: str) -> None:
+        findings.append(Finding(rule=rule, where=name, message=message,
+                                pass_name="cost"))
+
+    for prim in spec.forbid:
+        rec = cost.collectives.get(prim)
+        if rec and rec["count"]:
+            hit("forbidden-collective",
+                f"'{prim}' x{rec['count']} ({rec['bytes']} B payload) in a "
+                "trace whose CostSpec bans it — e.g. a full-table gather "
+                "sneaking into a ring halo")
+    for prim, want in spec.expect_counts.items():
+        got = cost.collectives.get(prim, {}).get("count", 0)
+        if got != want:
+            hit("collective-count",
+                f"'{prim}' count {got} != declared {want} — the halo "
+                "exchange structure drifted from its CostSpec")
+    for prim, ceiling in spec.max_bytes_per_op.items():
+        rec = cost.collectives.get(prim)
+        if rec and rec["max_op_bytes"] > ceiling:
+            hit("collective-bytes",
+                f"'{prim}' moves {rec['max_op_bytes']} B in one op, over "
+                f"the {ceiling} B per-op ceiling — a block grew beyond "
+                "its declared [N/D, H] shape")
+    if spec.max_total_bytes is not None \
+            and cost.collective_bytes > spec.max_total_bytes:
+        hit("collective-bytes",
+            f"total collective payload {cost.collective_bytes} B exceeds "
+            f"the {spec.max_total_bytes} B ceiling")
+    return findings

@@ -1,0 +1,261 @@
+"""graft-cost pass — static roofline model over traced entrypoints.
+
+graft-audit (invariants.py) pins *qualitative* hot-path properties; this
+module adds the *quantitative* dimension: walk each registered
+entrypoint's closed jaxpr and roll up
+
+* **FLOPs** per primitive — exact ``2·b·m·n·k`` for ``dot_general``
+  (separately exposed as ``dot_flops`` so tests can pin closed-form
+  counts, e.g. gather_matmul_segment = Σ_r 2·rows_r·H²), kernel-sized
+  counts for convolutions, one flop per output element for elementwise
+  ops, one per input element for reductions and cumulations, one per
+  update element for scatters;
+* **HBM read/write bytes** from operand/result avals of every leaf
+  equation — a traffic *model*, not a fusion-aware simulation: it is
+  deterministic, monotone in what the program materializes, and that is
+  exactly what a ratchet needs;
+* **peak live-intermediate bytes** via per-scope liveness (def →
+  last-use) with container equations contributing their inner scope's
+  peak while live;
+* **collective census** — dynamic count and payload bytes per collective
+  primitive (``ppermute``/``psum``/``all_gather``/…), checked against the
+  per-entrypoint :class:`~.comms.CostSpec` by comms.py.
+
+Loop handling: ``scan`` multiplies inner costs by its static ``length``
+(``fori_loop`` with Python-int bounds lowers to scan, so the ring halo's
+D ppermutes are counted, not just the single traced eqn); ``while``
+bodies are counted once (trip count is not static); ``cond`` sums all
+branches (a deterministic upper bound). Peak bytes are never multiplied —
+iterations reuse the same buffers.
+
+Everything here is abstract: no FLOP runs, big shapes cost nothing.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .comms import COLLECTIVE_PRIMS
+from .findings import Finding
+from .invariants import SCATTER_PRIMS, _aval_bytes, _iter_sub_jaxprs
+from .registry import SkipEntrypoint
+
+# one modeled flop per OUTPUT element
+ELEMENTWISE_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "pow", "integer_pow",
+    "exp", "exp2", "log", "log1p", "expm1", "tanh", "logistic", "erf",
+    "erfc", "erf_inv", "rsqrt", "sqrt", "cbrt", "sin", "cos", "tan",
+    "atan2", "neg", "abs", "sign", "floor", "ceil", "round", "clamp",
+    "select_n", "square", "nextafter", "is_finite",
+    "eq", "ne", "ge", "gt", "le", "lt", "and", "or", "xor", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+})
+# one modeled flop per INPUT element
+REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumprod", "cummax",
+    "cummin", "cumlogsumexp",
+})
+
+
+@dataclass
+class EntryCost:
+    """Rolled-up modeled cost of one traced entrypoint."""
+    name: str
+    flops: int = 0                 # total modeled FLOPs
+    dot_flops: int = 0             # dot_general subset (closed-form testable)
+    hbm_read_bytes: int = 0
+    hbm_write_bytes: int = 0
+    peak_intermediate_bytes: int = 0
+    collective_bytes: int = 0      # total payload over all collectives
+    # collective prim -> {"count", "bytes", "max_op_bytes"} (loop-weighted)
+    collectives: dict = field(default_factory=dict)
+    eqn_counts: dict = field(default_factory=dict)   # loop-weighted censuses
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.hbm_read_bytes + self.hbm_write_bytes
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        return self.flops / max(self.hbm_bytes, 1)
+
+    def to_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "dot_flops": self.dot_flops,
+            "hbm_read_bytes": self.hbm_read_bytes,
+            "hbm_write_bytes": self.hbm_write_bytes,
+            "hbm_bytes": self.hbm_bytes,
+            "peak_intermediate_bytes": self.peak_intermediate_bytes,
+            "arithmetic_intensity": round(self.arithmetic_intensity, 4),
+            "collective_bytes": self.collective_bytes,
+            "collectives": self.collectives,
+        }
+
+
+def _numel(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _dot_general_flops(eqn) -> int:
+    """2*b*m*n*k from the operand shapes and dimension numbers."""
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs = eqn.invars[0].aval.shape
+    rhs = eqn.invars[1].aval.shape
+    k = 1
+    for i in lc:
+        k *= int(lhs[i])
+    b = 1
+    for i in lb:
+        b *= int(lhs[i])
+    m = 1
+    for i, d in enumerate(lhs):
+        if i not in lc and i not in lb:
+            m *= int(d)
+    n = 1
+    for i, d in enumerate(rhs):
+        if i not in rc and i not in _rb:
+            n *= int(d)
+    return 2 * b * m * n * k
+
+
+def _conv_flops(eqn) -> int:
+    """2 * output elements * (per-group input channels * kernel spatial)."""
+    dn = eqn.params["dimension_numbers"]
+    rhs = eqn.invars[1].aval.shape
+    out_elems = _numel(eqn.outvars[0].aval)
+    spec = dn.rhs_spec            # (out_feat, in_feat, *spatial)
+    per_out = int(rhs[spec[1]])
+    for i in spec[2:]:
+        per_out *= int(rhs[i])
+    return 2 * out_elems * per_out
+
+
+def _eqn_flops(eqn) -> tuple[int, int]:
+    """(total_flops, dot_flops) modeled for one leaf equation."""
+    prim = eqn.primitive.name
+    if prim == "dot_general":
+        f = _dot_general_flops(eqn)
+        return f, f
+    if prim == "conv_general_dilated":
+        return _conv_flops(eqn), 0
+    if prim in ELEMENTWISE_PRIMS:
+        return sum(_numel(v.aval) for v in eqn.outvars), 0
+    if prim in REDUCE_PRIMS:
+        return sum(_numel(v.aval) for v in eqn.invars
+                   if not hasattr(v, "val")), 0
+    if prim in SCATTER_PRIMS:
+        # one accumulate per update element (invars: operand, indices, updates)
+        return _numel(eqn.invars[2].aval), 0
+    return 0, 0
+
+
+def _is_var(v) -> bool:
+    return not hasattr(v, "val")      # Literals carry .val
+
+
+def _eqn_sub_jaxprs(eqn):
+    for pv in eqn.params.values():
+        yield from _iter_sub_jaxprs(pv)
+
+
+def _scope_peak(jaxpr) -> int:
+    """Peak live bytes within one jaxpr scope (def → last-use liveness;
+    container eqns contribute their inner scope's peak while live)."""
+    eqns = jaxpr.eqns
+    last_use: dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if _is_var(v):
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            last_use[id(v)] = len(eqns)
+    alive: dict[int, int] = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        alive[id(v)] = _aval_bytes(v.aval)
+    peak = sum(alive.values())
+    for i, eqn in enumerate(eqns):
+        sub_peak = 0
+        for sub in _eqn_sub_jaxprs(eqn):
+            sub_peak = max(sub_peak, _scope_peak(sub))
+        for v in eqn.outvars:
+            alive[id(v)] = _aval_bytes(v.aval)
+        peak = max(peak, sum(alive.values()) + sub_peak)
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if _is_var(v) and last_use.get(id(v), -1) <= i:
+                alive.pop(id(v), None)
+    return peak
+
+
+def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
+    """Walk one traced entrypoint into an :class:`EntryCost`."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    cost = EntryCost(name=name)
+
+    def walk(jx, mult: int) -> None:
+        for eqn in jx.eqns:
+            prim = eqn.primitive.name
+            inner_mult = mult
+            if prim == "scan":
+                inner_mult = mult * int(eqn.params.get("length", 1))
+            subs = list(_eqn_sub_jaxprs(eqn))
+            if subs:
+                for sub in subs:
+                    walk(sub, inner_mult)
+                continue
+            cost.eqn_counts[prim] = cost.eqn_counts.get(prim, 0) + mult
+            flops, dot = _eqn_flops(eqn)
+            cost.flops += flops * mult
+            cost.dot_flops += dot * mult
+            reads = sum(_aval_bytes(v.aval) for v in eqn.invars if _is_var(v))
+            writes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            cost.hbm_read_bytes += reads * mult
+            cost.hbm_write_bytes += writes * mult
+            if prim in COLLECTIVE_PRIMS:
+                # payload: what moves over the interconnect — the gathered
+                # result for all_gather, the shipped operand otherwise
+                if prim == "all_gather":
+                    payload = writes
+                else:
+                    payload = reads
+                rec = cost.collectives.setdefault(
+                    prim, {"count": 0, "bytes": 0, "max_op_bytes": 0})
+                rec["count"] += mult
+                rec["bytes"] += payload * mult
+                rec["max_op_bytes"] = max(rec["max_op_bytes"], payload)
+                cost.collective_bytes += payload * mult
+
+    walk(jaxpr, 1)
+    cost.peak_intermediate_bytes = _scope_peak(jaxpr)
+    return cost
+
+
+def cost_entrypoint(entry) -> EntryCost:
+    """Build + trace + cost one registry entry."""
+    import jax
+    fn, args = entry.build()
+    return cost_jaxpr(entry.name, jax.make_jaxpr(fn)(*args))
+
+
+def cost_entrypoints(entrypoints):
+    """(name -> EntryCost, trace-failure findings, skipped names)."""
+    costs: dict[str, EntryCost] = {}
+    findings: list[Finding] = []
+    skipped: list[str] = []
+    for entry in entrypoints:
+        try:
+            costs[entry.name] = cost_entrypoint(entry)
+        except SkipEntrypoint as exc:
+            skipped.append(f"{entry.name} (skipped: {exc})")
+        except Exception as exc:  # graft-audit: allow[broad-except] any trace failure must surface as a finding, not crash the cost pass
+            findings.append(Finding(
+                rule="trace-error", where=entry.name,
+                message=f"{type(exc).__name__}: {exc}", pass_name="cost"))
+    return costs, findings, skipped

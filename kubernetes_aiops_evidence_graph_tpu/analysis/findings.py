@@ -33,11 +33,16 @@ class Finding:
 class Report:
     findings: list[Finding] = field(default_factory=list)
     entrypoints_audited: list[str] = field(default_factory=list)
+    # graft-cost section (per-entrypoint modeled costs + baseline deltas);
+    # empty unless the cost pass ran
+    cost: dict = field(default_factory=dict)
 
     def extend(self, other: "Report | list[Finding]") -> None:
         if isinstance(other, Report):
             self.findings.extend(other.findings)
             self.entrypoints_audited.extend(other.entrypoints_audited)
+            if other.cost:
+                self.cost = other.cost
         else:
             self.findings.extend(other)
 
@@ -54,7 +59,7 @@ class Report:
         return 1 if self.violations else 0
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "tool": "graft-audit",
             "ok": not self.violations,
             "summary": {
@@ -66,6 +71,9 @@ class Report:
             "violations": [f.to_dict() for f in self.violations],
             "waived": [f.to_dict() for f in self.waivers],
         }
+        if self.cost:
+            d["cost"] = self.cost
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2)
@@ -77,6 +85,22 @@ class Report:
         for f in self.waivers:
             lines.append(f"waived    [{f.pass_name}/{f.rule}] {f.where}: "
                          f"{f.waiver_reason or f.message}")
+        for name, c in self.cost.get("entrypoints", {}).items():
+            vs = c.get("vs_baseline") or {}
+            flops_d = vs.get("flops")
+            delta = (f" ({flops_d * 100:+.1f}% FLOPs vs baseline)"
+                     if isinstance(flops_d, float) else "")
+            lines.append(
+                f"cost      {name}: {c['flops'] / 1e6:.1f} MFLOP, "
+                f"{c['hbm_bytes'] / 1e6:.1f} MB HBM, "
+                f"peak {c['peak_intermediate_bytes'] / 1e6:.1f} MB, "
+                f"AI {c['arithmetic_intensity']:.2f}, "
+                f"collectives {c['collective_bytes'] / 1e6:.2f} MB{delta}")
+        if self.cost:
+            lines.append(
+                f"graft-cost: {len(self.cost.get('entrypoints', {}))} "
+                f"entrypoint(s) modeled against {self.cost.get('baseline')}"
+                + (" (baseline UPDATED)" if self.cost.get("updated") else ""))
         lines.append(
             f"graft-audit: {len(self.violations)} violation(s), "
             f"{len(self.waivers)} waived site(s), "

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable
 
+from .comms import CostSpec
 from .invariants import CALLBACK_PRIMS, InvariantSpec
 
 MIB = 1 << 20
@@ -29,6 +30,8 @@ N_INC = 128            # padded incident rows
 # per-relation live edge counts for the 9 RelationKinds — drawn so the
 # ladder caps are exact powers of two (rel_slice_offsets identity)
 REL_COUNTS = (4096, 4096, 2048, 2048, 1024, 1024, 512, 512, 256)
+# graph-axis shard count the sharded entrypoints trace with
+GRAPH_SHARDS = 2
 
 # the hot-path budget: comfortably above the largest legitimate
 # intermediate at the canonical shapes ([N, H] f32 = 4 MiB) and far below
@@ -56,6 +59,9 @@ class Entrypoint:
     build: Callable[[], tuple[Callable, tuple]]
     spec: InvariantSpec
     notes: str = ""
+    # collective-traffic contract for the graft-cost pass; None means the
+    # single-device default (no collectives at all) — see comms.COST_DEFAULT
+    cost: "CostSpec | None" = None
 
 
 def _np():
@@ -156,7 +162,7 @@ def _sharded_build(halo: str):
         from ..parallel.mesh import make_mesh
         from ..parallel.sharded_gnn import _sharded_loss
         d = len(jax.devices())
-        graph = 2
+        graph = GRAPH_SHARDS
         dp = d // graph
         mesh = make_mesh(dp=dp, graph=graph)
         a = _gnn_arrays()
@@ -281,6 +287,30 @@ _HOT = InvariantSpec(forbid_primitives=NO_SET_SCATTER,
 # their mirror never promises within-slice dst order under churn
 _TICK = InvariantSpec(max_intermediate_bytes=HOT_BUDGET)
 
+# -- collective-traffic contracts (graft-cost; comms.py) -------------------
+# Entrypoints without a CostSpec get the single-device default: no
+# collectives at all. The two sharded halos declare their EXACT census at
+# canonical shapes — counts are loop-weighted (the ring's per-layer
+# fori_loop lowers to a scan of length GRAPH_SHARDS).
+_NPS = N_NODES // GRAPH_SHARDS
+# allgather halo: one full-[N, H] gather per layer + one for the readout,
+# plus the two scalar loss psums over dp; never a ring or a reduce-scatter
+_ALLGATHER_COST = CostSpec(
+    expect_counts={"all_gather": LAYERS + 1, "psum": 2, "ppermute": 0},
+    forbid=("reduce_scatter", "psum_scatter", "all_to_all"),
+    max_bytes_per_op={"all_gather": N_NODES * HIDDEN * 4},
+    max_total_bytes=(LAYERS + 1) * N_NODES * HIDDEN * 4 + 1024,
+)
+# ring halo: GRAPH_SHARDS ppermutes of one [N/D, H] block per layer plus
+# the streamed readout, and ZERO full-[N, H] all-gathers — the whole point
+# of the ring is O(N/D) resident remote bytes
+_RING_COST = CostSpec(
+    expect_counts={"ppermute": (LAYERS + 1) * GRAPH_SHARDS, "psum": 2},
+    forbid=("all_gather", "reduce_scatter", "psum_scatter", "all_to_all"),
+    max_bytes_per_op={"ppermute": _NPS * HIDDEN * 4},
+    max_total_bytes=(LAYERS + 1) * GRAPH_SHARDS * _NPS * HIDDEN * 4 + 1024,
+)
+
 
 ENTRYPOINTS: tuple[Entrypoint, ...] = (
     Entrypoint(
@@ -309,12 +339,14 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
     Entrypoint(
         "sharded_gnn.loss.allgather.bucketed", _sharded_build("allgather"),
         InvariantSpec(max_intermediate_bytes=HOT_BUDGET,
-                      expect_sorted_scatter=True)),
+                      expect_sorted_scatter=True),
+        cost=_ALLGATHER_COST),
     Entrypoint(
         "sharded_gnn.loss.ring.bucketed", _sharded_build("ring"),
         InvariantSpec(max_intermediate_bytes=HOT_BUDGET),
         notes="ring halo: per-block mask breaks the per-slice sorted "
-              "promise, so no sorted-scatter expectation"),
+              "promise, so no sorted-scatter expectation",
+        cost=_RING_COST),
     Entrypoint("streaming.rules_tick", _rules_tick_build, _TICK),
     Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
     Entrypoint("ops.gather_matmul_segment", _gms_build(), _HOT),

@@ -1,0 +1,28 @@
+"""Seeded graft-cost fixture: HBM-byte inflation.
+
+The committed fixture baseline (cost_baseline_bytes.json) records the
+traffic of a lean [4096, 64] elementwise kernel; this trace materializes
+a dense [4096, 9, 64] relation-expanded copy first — the [N, R, H]-shape
+regression the bucketed kernels exist to avoid. Modeled HBM bytes and
+peak intermediate bytes blow past the +5% tolerance while the FLOP
+baseline is deliberately generous. Must produce EXACTLY the
+``cost-bytes`` finding(s) and a non-zero exit.
+"""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import Entrypoint
+
+
+def _build():
+    import jax.numpy as jnp
+    x = np.zeros((4096, 64), np.float32)
+
+    def f(h):
+        dense = h[:, None, :] * jnp.ones((1, 9, 1), h.dtype)  # [N, R, H]
+        return dense.sum(axis=1)
+
+    return f, (x,)
+
+
+ENTRYPOINTS = (Entrypoint("fixture.cost.bytes", _build, InvariantSpec()),)

@@ -1,0 +1,27 @@
+"""Seeded graft-cost fixture: FLOP inflation.
+
+The committed fixture baseline (cost_baseline_flops.json) records the
+cost of ONE [256, 256] matmul; this trace performs TWO — the modeled
+FLOPs roughly double, far past the +2% tolerance, while every byte
+metric stays inside its (deliberately generous) baseline. Driven by
+tests/test_graft_cost.py via
+``--cost --jaxpr-fixture cost_bad_flops --cost-baseline ...`` and must
+produce EXACTLY one ``cost-flops`` finding.
+"""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import Entrypoint
+
+
+def _build():
+    a = np.zeros((256, 256), np.float32)
+
+    def f(x):
+        y = x @ x
+        return y @ x       # the seeded regression: a second matmul
+
+    return f, (a,)
+
+
+ENTRYPOINTS = (Entrypoint("fixture.cost.flops", _build, InvariantSpec()),)

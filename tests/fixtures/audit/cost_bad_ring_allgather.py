@@ -1,0 +1,55 @@
+"""Seeded graft-cost fixture: a full all-gather inside a ring halo.
+
+A miniature of parallel/sharded_gnn.py's ring exchange — a fori_loop of
+``ppermute`` steps over a 2-shard graph axis — with the seeded
+regression: a convenience ``all_gather`` of the full block table, which
+the ring's whole design exists to avoid (O(N/D) resident remote bytes).
+The CostSpec declares the honest census (2 loop-weighted ppermutes) and
+bans ``all_gather`` outright; the fixture baseline is generous on every
+ratcheted metric so the run produces EXACTLY one
+``forbidden-collective`` finding and a non-zero exit.
+"""
+import numpy as np
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.comms import CostSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.invariants import InvariantSpec
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+    Entrypoint, SkipEntrypoint)
+
+
+def _build():
+    import jax
+    import jax.numpy as jnp
+    if len(jax.devices()) < 2:
+        raise SkipEntrypoint("needs >= 2 devices for the graph axis")
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from kubernetes_aiops_evidence_graph_tpu.parallel.compat import shard_map
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("graph",))
+
+    def local(x):
+        h = x[0]
+
+        def body(r, carry):
+            blk, acc = carry
+            acc = acc + blk
+            blk = jax.lax.ppermute(blk, "graph", [(0, 1), (1, 0)])
+            return blk, acc
+
+        _, acc = jax.lax.fori_loop(0, 2, body, (h, jnp.zeros_like(h)))
+        full = jax.lax.all_gather(h, "graph", tiled=True)  # the regression
+        return (acc + full[: h.shape[0]])[None]
+
+    fn = shard_map(local, mesh=mesh, in_specs=P("graph"),
+                   out_specs=P("graph"), check_vma=False)
+    # leading [G] shard axis, same layout discipline as registry._sharded_build
+    return fn, (np.zeros((2, 128, 64), np.float32),)
+
+
+ENTRYPOINTS = (
+    Entrypoint(
+        "fixture.cost.ring", _build, InvariantSpec(),
+        cost=CostSpec(expect_counts={"ppermute": 2},
+                      forbid=("all_gather",))),
+)

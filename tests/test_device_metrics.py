@@ -5,6 +5,7 @@ roofline arithmetic must be self-consistent."""
 import numpy as np
 import jax
 
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import HIDDEN
 from kubernetes_aiops_evidence_graph_tpu.rca import device_metrics as dm
 from kubernetes_aiops_evidence_graph_tpu.rca import get_backend
 from kubernetes_aiops_evidence_graph_tpu.rca.ruleset import NUM_CONDS, NUM_RULES
@@ -58,8 +59,9 @@ def test_fold_accounting_scales_linearly_in_width():
 
 
 def test_gnn_layer_accounting_matmul_flops_dominate():
-    acct = dm.gnn_layer_accounting(pn=4096, e=16384, hidden=64)
-    assert acct["flops"] >= 4 * 4096 * 64 * 64  # the two matmuls
+    # hidden width from the canonical registry shapes — one source of truth
+    acct = dm.gnn_layer_accounting(pn=4096, e=16384, hidden=HIDDEN)
+    assert acct["flops"] >= 4 * 4096 * HIDDEN * HIDDEN  # the two matmuls
     assert acct["bytes"] == acct["reads"] + acct["writes"]
 
 

@@ -1,0 +1,189 @@
+"""graft-cost: the static roofline + collective ratchet's own tests
+(marker ``static_audit``).
+
+Four layers:
+
+* closed-form pins — the modeled dot FLOPs of ``ops.gather_matmul_segment``
+  at canonical shapes must equal Σ_r 2·rows_r·H² EXACTLY (the cost model
+  is only trustworthy if its arithmetic is, and this kernel has an exact
+  hand count);
+* seeded-regression fixtures under tests/fixtures/audit — FLOP inflation,
+  HBM-byte inflation, and a full all-gather inside a ring halo must each
+  produce exactly its finding and a non-zero CLI exit against its
+  committed fixture baseline;
+* the ratchet itself — the repo must be clean against the committed
+  COST_BASELINE.json, and a CLI ``--update-baseline`` → ``--cost``
+  round-trip must be clean by construction;
+* docs/contract drift — every registered entrypoint name must appear in
+  PARITY.md's cost table, and the registry's collective contracts must
+  keep the ring/allgather halo census pinned.
+"""
+import importlib
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.analysis import run_audit
+from kubernetes_aiops_evidence_graph_tpu.analysis.baseline import (
+    default_baseline_path, run_cost_pass)
+from kubernetes_aiops_evidence_graph_tpu.analysis.comms import (
+    COLLECTIVE_PRIMS, COST_DEFAULT)
+from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+    cost_entrypoint)
+from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+    ENTRYPOINTS, GRAPH_SHARDS, HIDDEN, LAYERS, N_NODES, REL_COUNTS)
+
+pytestmark = pytest.mark.static_audit
+
+FIXTURES = Path(__file__).parent / "fixtures" / "audit"
+BY_NAME = {e.name: e for e in ENTRYPOINTS}
+
+# fixture module -> (its baseline JSON, the ONE rule it must trip)
+COST_FIXTURES = {
+    "cost_bad_flops": ("cost_baseline_flops.json", "cost-flops"),
+    "cost_bad_bytes": ("cost_baseline_bytes.json", "cost-bytes"),
+    "cost_bad_ring_allgather": ("cost_baseline_ring.json",
+                                "forbidden-collective"),
+}
+
+
+# -- closed-form pins ------------------------------------------------------
+
+def test_gather_matmul_segment_dot_flops_match_closed_form():
+    """Σ_r 2·rows_r·H² exactly — rows_r from the canonical slice table."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.snapshot import (
+        rel_slice_offsets)
+    offs = rel_slice_offsets(REL_COUNTS)
+    rows = [int(offs[r + 1] - offs[r]) for r in range(len(offs) - 1)]
+    want = sum(2 * r * HIDDEN * HIDDEN for r in rows)
+    cost = cost_entrypoint(BY_NAME["ops.gather_matmul_segment"])
+    assert cost.dot_flops == want
+    # the bf16 variant casts operands, never changes the FLOP count
+    bf16 = cost_entrypoint(BY_NAME["ops.gather_matmul_segment.bf16"])
+    assert bf16.dot_flops == want
+    # and moves fewer HBM bytes (half-width gather rows)
+    assert bf16.hbm_bytes < cost.hbm_bytes
+
+
+def test_ring_collective_census_matches_its_spec_arithmetic():
+    """The traced ring halo moves exactly (LAYERS+1)·D ppermutes of
+    [N/D, H] f32 blocks and zero all-gathers — the contract the CostSpec
+    declares, recomputed here from first principles."""
+    cost = cost_entrypoint(BY_NAME["sharded_gnn.loss.ring.bucketed"])
+    perm = cost.collectives["ppermute"]
+    assert perm["count"] == (LAYERS + 1) * GRAPH_SHARDS
+    assert perm["max_op_bytes"] == (N_NODES // GRAPH_SHARDS) * HIDDEN * 4
+    assert "all_gather" not in cost.collectives
+    ag = cost_entrypoint(BY_NAME["sharded_gnn.loss.allgather.bucketed"])
+    gat = ag.collectives["all_gather"]
+    assert gat["count"] == LAYERS + 1
+    assert gat["max_op_bytes"] == N_NODES * HIDDEN * 4
+    assert "ppermute" not in ag.collectives
+
+
+# -- seeded-regression fixtures (subprocess: the CLI's virtual-mesh setup
+#    is import-time, and a non-zero exit is part of the contract) ---------
+
+@pytest.mark.parametrize("module", sorted(COST_FIXTURES))
+def test_cli_exits_nonzero_on_each_seeded_cost_fixture(module):
+    baseline, rule = COST_FIXTURES[module]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(FIXTURES), env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_aiops_evidence_graph_tpu.analysis",
+         "--cost", "--skip-ast", "--skip-jaxpr", "--jaxpr-fixture", module,
+         "--cost-baseline", str(FIXTURES / baseline), "--report", "json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    # exactly the seeded finding — no collateral noise from other metrics
+    assert [v["rule"] for v in report["violations"]] == [rule], \
+        report["violations"]
+
+
+# -- the ratchet: repo clean against the committed baseline ---------------
+
+def test_repo_is_clean_against_committed_cost_baseline():
+    assert default_baseline_path().exists(), \
+        "COST_BASELINE.json missing — run --update-baseline and commit it"
+    report = run_audit(jaxpr=False, ast=False, cost=True)
+    assert report.violations == [], report.to_text()
+    modeled = set(report.cost["entrypoints"])
+    skipped = {s.split(" ", 1)[0] for s in report.cost["skipped"]}
+    assert modeled | skipped == {e.name for e in ENTRYPOINTS}
+
+
+def test_update_baseline_then_cost_round_trips_clean(tmp_path):
+    """--update-baseline followed by --cost must be clean by construction
+    (same traces, fresh baseline)."""
+    bl = tmp_path / "COST_BASELINE.json"
+    last = None
+    for extra in (["--update-baseline"], ["--cost"]):
+        last = subprocess.run(
+            [sys.executable, "-m",
+             "kubernetes_aiops_evidence_graph_tpu.analysis",
+             "--skip-ast", "--skip-jaxpr", "--cost-baseline", str(bl),
+             "--report", "json", *extra],
+            capture_output=True, text=True, timeout=300)
+        assert last.returncode == 0, last.stdout + last.stderr
+    report = json.loads(last.stdout)
+    assert report["ok"]
+    ents = report["cost"]["entrypoints"]
+    assert ents, "cost section empty after round-trip"
+    for name, c in ents.items():
+        for key, delta in c["vs_baseline"].items():
+            assert delta == 0.0, (name, key, delta)
+
+
+def test_allow_cost_pragma_waives_but_counts_the_regression(tmp_path,
+                                                            monkeypatch):
+    """An intentional regression carries # graft-audit: allow[cost] next
+    to the registration — reported as waived, never dropped, exit 0."""
+    src = (FIXTURES / "cost_bad_flops.py").read_text().replace(
+        'ENTRYPOINTS = (Entrypoint("fixture.cost.flops", _build, '
+        'InvariantSpec()),)',
+        'ENTRYPOINTS = (\n'
+        '    # graft-audit: allow[cost] intentional second matmul, '
+        'accuracy over FLOPs\n'
+        '    Entrypoint("fixture.cost.flops", _build, InvariantSpec()),\n'
+        ')')
+    assert "allow[cost]" in src
+    (tmp_path / "cost_waived_fixture.py").write_text(src)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    mod = importlib.import_module("cost_waived_fixture")
+    findings, _ = run_cost_pass(
+        entry_module=mod,
+        baseline_path=FIXTURES / "cost_baseline_flops.json")
+    assert findings, "the seeded regression disappeared"
+    assert all(f.waived for f in findings)
+    assert "intentional" in findings[0].waiver_reason
+
+
+# -- docs / contract drift -------------------------------------------------
+
+def test_every_entrypoint_name_appears_in_parity_table():
+    parity = (Path(__file__).parent.parent / "PARITY.md").read_text()
+    missing = [e.name for e in ENTRYPOINTS if e.name not in parity]
+    assert not missing, \
+        f"PARITY.md cost table is missing entrypoints: {missing}"
+
+
+def test_registry_pins_the_collective_contracts():
+    ring = BY_NAME["sharded_gnn.loss.ring.bucketed"].cost
+    assert "all_gather" in ring.forbid
+    assert ring.expect_counts["ppermute"] == (LAYERS + 1) * GRAPH_SHARDS
+    assert ring.max_bytes_per_op["ppermute"] == \
+        (N_NODES // GRAPH_SHARDS) * HIDDEN * 4
+    ag = BY_NAME["sharded_gnn.loss.allgather.bucketed"].cost
+    assert ag.expect_counts["all_gather"] == LAYERS + 1
+    assert ag.max_total_bytes is not None and ring.max_total_bytes is not None
+    # every single-device entrypoint keeps the no-collectives default
+    for e in ENTRYPOINTS:
+        if not e.name.startswith("sharded_gnn."):
+            assert e.cost is None, e.name
+    assert set(COST_DEFAULT.forbid) == set(COLLECTIVE_PRIMS)
