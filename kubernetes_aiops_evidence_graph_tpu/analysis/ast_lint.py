@@ -9,6 +9,9 @@ Rules (ids are what the waiver pragma names):
   and exempt.
 * ``np-in-traced``    — ``np.*`` calls inside jitted/traced code run on
   host per trace, constant-folding device data out of the jaxpr.
+  ``pl.pallas_call`` kernel bodies count as traced code too (refs and
+  scalars are traced values; np-in-traced / tracer-branch / wall-clock
+  apply inside kernels).
 * ``wall-clock``      — ``time.time()`` anywhere: NTP steps make it
   non-monotonic; durations must use monotonic()/perf_counter(). Epoch
   timestamps for export are waivable.
@@ -47,7 +50,8 @@ HOT_DIRS = {"rca", "ops", "parallel"}
 # np-in-traced apply inside them too
 TRACED_EXTRA = {
     "forward", "loss_fn", "rel_messages", "_message_pass",
-    "_message_pass_bucketed", "gather_matmul_segment", "scatter_add",
+    "_message_pass_bucketed", "gather_matmul_segment",
+    "pallas_gather_matmul_segment", "scatter_add",
     "scatter_max", "scatter_add_2d", "gather_neighbors", "_aggregate",
     "finish_scores", "pair_contract", "_ring_messages", "_ring_readout",
     "local_loss", "local_score", "local_tick",
@@ -55,7 +59,8 @@ TRACED_EXTRA = {
 
 # calls that produce device values (for the host-sync dataflow)
 DEVICE_RETURNING = {
-    "forward_batch", "gather_matmul_segment", "k_hop_reach",
+    "forward_batch", "gather_matmul_segment",
+    "pallas_gather_matmul_segment", "k_hop_reach",
     "propagate_labels", "segment_sum", "scatter_add", "scatter_max",
 }
 # explicit-transfer calls: an expression containing one is sanctioned
@@ -77,7 +82,8 @@ SYNC_METHODS = {"item", "tolist"}
 JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]] = {
     ("rca/gnn.py", "step"): (("rel_offsets", "slices_sorted"), (0, 1)),
     ("rca/gnn.py", "forward"): (
-        ("sorted_by_dst", "rel_offsets", "slices_sorted", "compute_dtype"),
+        ("sorted_by_dst", "rel_offsets", "slices_sorted", "compute_dtype",
+         "pallas"),
         ()),
     ("rca/gnn_streaming.py", "_gnn_tick"): (
         ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype"),
@@ -93,7 +99,7 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
     ("rca/device_metrics.py", "_loop_score"): (
         ("padded_incidents", "pair_width"), ()),
     ("rca/device_metrics.py", "scan_fwd"): (
-        ("k", "sorted_", "offs", "ss", "cd"), ()),
+        ("k", "sorted_", "offs", "ss", "cd", "pal"), ()),
     ("ops/propagate.py", "k_hop_reach"): (("num_nodes", "hops"), ()),
     ("ops/propagate.py", "propagate_labels"): (
         ("num_nodes", "iterations"), ()),
@@ -198,8 +204,15 @@ class _FileLint:
                 self.waivers[i] = (rules, m.group(2).strip())
         # jit call-form targets in this module: jax.jit(fn_name, ...)
         self.call_form_jits: dict[str, tuple[set[str], tuple[int, ...], int]] = {}
+        # functions handed to pl.pallas_call as the kernel body: traced
+        # code (refs and scalars are traced values), so the np-in-traced /
+        # tracer-branch rules apply inside them
+        self.pallas_kernels: set[str] = set()
         for n in ast.walk(self.tree):
-            if isinstance(n, ast.Call) and _call_name(n) in ("jax.jit", "jit"):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _call_name(n)
+            if name in ("jax.jit", "jit"):
                 statics, donate = _static_argnames_from_call(n)
                 if n.args and isinstance(n.args[0], ast.Name):
                     self.call_form_jits[n.args[0].id] = (statics, donate,
@@ -207,6 +220,10 @@ class _FileLint:
                 elif n.args and isinstance(n.args[0], ast.Lambda):
                     self.call_form_jits["<lambda>"] = (statics, donate,
                                                        n.lineno)
+            elif name in ("pl.pallas_call", "pallas_call",
+                          "pltpu.pallas_call"):
+                if n.args and isinstance(n.args[0], ast.Name):
+                    self.pallas_kernels.add(n.args[0].id)
 
     def hit(self, rule: str, line: int, message: str) -> None:
         waived, reason = False, ""
@@ -267,6 +284,9 @@ class _FileLint:
                 out.append((n, dec[0]))
             elif n.name in self.call_form_jits:
                 out.append((n, self.call_form_jits[n.name][0]))
+            elif n.name in self.pallas_kernels:
+                # pallas kernel bodies are traced wherever they live
+                out.append((n, self._annotated_static_params(n)))
             elif self.in_hot and n.name in TRACED_EXTRA:
                 # statics by convention: int/bool-annotated params
                 out.append((n, self._annotated_static_params(n)))
@@ -425,10 +445,15 @@ def package_root() -> Path:
     return Path(__file__).resolve().parent.parent
 
 
-def lint_tree(root: "Path | str | None" = None) -> Report:
-    """Lint every .py under ``root`` (default: the installed package)."""
+def lint_tree(root: "Path | str | None" = None,
+              check_jit_declarations: "bool | None" = None) -> Report:
+    """Lint every .py under ``root`` (default: the installed package).
+    ``check_jit_declarations`` defaults to on only for the installed
+    package (fixture trees are not in JIT_DECLARATIONS); fixtures that
+    seed a ``jit-undeclared`` finding pass True explicitly."""
     base = Path(root) if root is not None else package_root()
-    check_decls = root is None
+    check_decls = (root is None if check_jit_declarations is None
+                   else check_jit_declarations)
     report = Report()
     for path in sorted(base.rglob("*.py")):
         if "__pycache__" in path.parts:

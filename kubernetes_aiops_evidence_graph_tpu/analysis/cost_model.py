@@ -13,10 +13,17 @@ entrypoint's closed jaxpr and roll up
 * **HBM read/write bytes** from operand/result avals of every leaf
   equation — a traffic *model*, not a fusion-aware simulation: it is
   deterministic, monotone in what the program materializes, and that is
-  exactly what a ratchet needs;
+  exactly what a ratchet needs. Ref-typed avals (Pallas kernel refs —
+  resident VMEM buffers) are excluded: a ``get``/``swap`` equation moves
+  its VALUE operands/results, not the whole buffer it indexes into, so
+  only non-ref avals count (otherwise a tiled kernel's per-edge row get
+  would model the full ``[N, H]`` table per iteration);
 * **peak live-intermediate bytes** via per-scope liveness (def →
   last-use) with container equations contributing their inner scope's
-  peak while live;
+  peak while live. Ref avals are excluded here too: a kernel ref is a
+  VMEM view of an outer operand that is already alive in the enclosing
+  scope, so counting the ref again would double-charge every resident
+  buffer;
 * **collective census** — dynamic count and payload bytes per collective
   primitive (``ppermute``/``psum``/``all_gather``/…), checked against the
   per-entrypoint :class:`~.comms.CostSpec` by comms.py.
@@ -25,8 +32,12 @@ Loop handling: ``scan`` multiplies inner costs by its static ``length``
 (``fori_loop`` with Python-int bounds lowers to scan, so the ring halo's
 D ppermutes are counted, not just the single traced eqn); ``while``
 bodies are counted once (trip count is not static); ``cond`` sums all
-branches (a deterministic upper bound). Peak bytes are never multiplied —
-iterations reuse the same buffers.
+branches (a deterministic upper bound); ``pallas_call`` kernel bodies are
+multiplied by the static grid size (the traced jaxpr is ONE grid step —
+without the weight a tiled kernel would model a single tile's FLOPs, and
+the closed-form pins in tests/test_graft_cost.py would not hold). Peak
+bytes are never multiplied — iterations reuse the same buffers, and a
+Pallas grid revisits the same VMEM blocks.
 
 Everything here is abstract: no FLOP runs, big shapes cost nothing.
 """
@@ -160,6 +171,11 @@ def _is_var(v) -> bool:
     return not hasattr(v, "val")      # Literals carry .val
 
 
+def _is_ref(aval) -> bool:
+    """Pallas/state Ref avals (resident buffers, not streamed values)."""
+    return hasattr(aval, "inner_aval")
+
+
 def _eqn_sub_jaxprs(eqn):
     for pv in eqn.params.values():
         yield from _iter_sub_jaxprs(pv)
@@ -179,14 +195,16 @@ def _scope_peak(jaxpr) -> int:
             last_use[id(v)] = len(eqns)
     alive: dict[int, int] = {}
     for v in list(jaxpr.invars) + list(jaxpr.constvars):
-        alive[id(v)] = _aval_bytes(v.aval)
+        if not _is_ref(v.aval):     # refs alias buffers the OUTER scope owns
+            alive[id(v)] = _aval_bytes(v.aval)
     peak = sum(alive.values())
     for i, eqn in enumerate(eqns):
         sub_peak = 0
         for sub in _eqn_sub_jaxprs(eqn):
             sub_peak = max(sub_peak, _scope_peak(sub))
         for v in eqn.outvars:
-            alive[id(v)] = _aval_bytes(v.aval)
+            if not _is_ref(v.aval):
+                alive[id(v)] = _aval_bytes(v.aval)
         peak = max(peak, sum(alive.values()) + sub_peak)
         for v in list(eqn.invars) + list(eqn.outvars):
             if _is_var(v) and last_use.get(id(v), -1) <= i:
@@ -205,6 +223,14 @@ def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
             inner_mult = mult
             if prim == "scan":
                 inner_mult = mult * int(eqn.params.get("length", 1))
+            elif prim == "pallas_call":
+                # the kernel jaxpr is one grid step: weight by grid size
+                grid = getattr(eqn.params.get("grid_mapping"), "grid",
+                               ()) or ()
+                steps = 1
+                for d in grid:
+                    steps *= int(d)
+                inner_mult = mult * max(steps, 1)
             subs = list(_eqn_sub_jaxprs(eqn))
             if subs:
                 for sub in subs:
@@ -214,8 +240,10 @@ def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
             flops, dot = _eqn_flops(eqn)
             cost.flops += flops * mult
             cost.dot_flops += dot * mult
-            reads = sum(_aval_bytes(v.aval) for v in eqn.invars if _is_var(v))
-            writes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            reads = sum(_aval_bytes(v.aval) for v in eqn.invars
+                        if _is_var(v) and not _is_ref(v.aval))
+            writes = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                         if not _is_ref(v.aval))
             cost.hbm_read_bytes += reads * mult
             cost.hbm_write_bytes += writes * mult
             if prim in COLLECTIVE_PRIMS:

@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable
 
-from .comms import CostSpec
+from .comms import COST_DEFAULT, CostSpec
 from .invariants import CALLBACK_PRIMS, InvariantSpec
 
 MIB = 1 << 20
@@ -41,6 +41,18 @@ HOT_BUDGET = 8 * MIB
 # [N, R, H] einsum (36 MiB at canonical shapes); anything beyond that is
 # new regression even for the oracle
 REFERENCE_BUDGET = 40 * MIB
+
+# canonical shapes for the Pallas gather_matmul_segment entrypoints:
+# DELIBERATELY small-N / big-slice so the byte budget separates what the
+# kernel may materialize from what it must not — the unavoidable [N, H]
+# accumulator/output is 1 MiB, every in-kernel intermediate is
+# [EDGE_TILE, H] tile scale (128 KiB), while a single full-slice
+# [E_r, H] gather/message materialization (the XLA kernel's working set)
+# is >= 4 MiB and a whole-[E, H] table ~15 MiB. The 2 MiB budget admits
+# the accumulator and rejects anything slice-scaled.
+PALLAS_N = 4096
+PALLAS_REL_COUNTS = tuple(4 * c for c in REL_COUNTS)
+PALLAS_TILE_BUDGET = 2 * MIB
 
 # bucketed forward paths may not contain a set-scatter at all — the only
 # scatters are the per-slice 1-D dst segment-adds
@@ -247,6 +259,45 @@ def _gms_build(compute_dtype=None):
     return build
 
 
+def _pallas_gms_build(compute_dtype=None):
+    def build():
+        np = _np()
+        from ..graph.snapshot import rel_slice_offsets
+        from ..ops.pallas_segment import pallas_gather_matmul_segment
+        offs = rel_slice_offsets(PALLAS_REL_COUNTS)
+        n, h = PALLAS_N, HIDDEN
+        pe = int(offs[-1])
+        rng = np.random.default_rng(0)
+        src = rng.integers(0, n, pe).astype(np.int32)
+        # live prefixes dst-sorted, padding pinned to the last row — the
+        # snapshot layout contract, same as _gnn_arrays
+        dst = np.full(pe, n - 1, np.int32)
+        mask = np.zeros(pe, np.float32)
+        for r, (lo, hi) in enumerate(zip(offs[:-1], offs[1:])):
+            c = PALLAS_REL_COUNTS[r]
+            dst[lo:lo + c] = np.sort(rng.integers(0, n, c)).astype(np.int32)
+            mask[lo:lo + c] = 1.0
+        fn = partial(pallas_gather_matmul_segment, rel_offsets=offs,
+                     num_segments=n, slices_sorted=True,
+                     compute_dtype=compute_dtype, interpret=True)
+        args = (np.zeros((n, h), np.float32),
+                np.zeros((len(PALLAS_REL_COUNTS), h, h), np.float32),
+                src, dst, mask)
+        return fn, args
+    return build
+
+
+def _forward_pallas_build():
+    from ..rca import gnn
+    a = _gnn_arrays()
+    fn = partial(gnn.forward, rel_offsets=a["rel_offsets"],
+                 slices_sorted=True, pallas=True)
+    args = (_params(), a["features"], a["node_kind"], a["node_mask"],
+            a["edge_src"], a["edge_dst"], a["edge_rel"], a["edge_mask"],
+            a["incident_nodes"])
+    return fn, args
+
+
 def _k_hop_build():
     np = _np()
     from ..ops.propagate import k_hop_reach
@@ -347,6 +398,14 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
         notes="ring halo: per-block mask breaks the per-slice sorted "
               "promise, so no sorted-scatter expectation",
         cost=_RING_COST),
+    Entrypoint(
+        "gnn.forward.bucketed.pallas", _forward_pallas_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="Pallas serving tier (settings.gnn_pallas): message passing "
+              "runs inside pl.pallas_call, so no lax scatter exists to "
+              "carry the sorted promise — expect_sorted_scatter stays off",
+        cost=COST_DEFAULT),
     Entrypoint("streaming.rules_tick", _rules_tick_build, _TICK),
     Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
     Entrypoint("ops.gather_matmul_segment", _gms_build(), _HOT),
@@ -355,6 +414,24 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
         InvariantSpec(forbid_primitives=NO_SET_SCATTER,
                       max_intermediate_bytes=HOT_BUDGET,
                       expect_sorted_scatter=True, bf16_accum_f32=True)),
+    Entrypoint(
+        "ops.pallas_gather_matmul_segment", _pallas_gms_build(),
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=PALLAS_TILE_BUDGET),
+        notes="VMEM-tile byte budget: the [N, H] accumulator (1 MiB at "
+              "the pallas canonical shapes) is the ceiling — any "
+              "[E_r, H] slice-scale materialization (>= 4 MiB here) "
+              "fails; explicit COST_DEFAULT pins zero collectives",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "ops.pallas_gather_matmul_segment.bf16",
+        _pallas_gms_build("bfloat16"),
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=PALLAS_TILE_BUDGET,
+                      bf16_accum_f32=True),
+        notes="bf16 operands must still accumulate into f32 inside the "
+              "kernel (preferred_element_type on the tile matmul)",
+        cost=COST_DEFAULT),
     Entrypoint(
         "ops.k_hop_reach", _k_hop_build,
         InvariantSpec(forbid_primitives=NO_SET_SCATTER,

@@ -70,6 +70,12 @@ class Settings:
     # "" = f32 matmuls; "bfloat16" = bf16 matmul operands with f32
     # accumulation (segment-sum and residual stay f32)
     gnn_compute_dtype: str = ""
+    # Pallas serving tier for the bucketed forward (ops/pallas_segment.py):
+    # tiled VMEM-resident gather→matmul→accumulate kernel, bit-identical
+    # to the XLA bucketed kernel. FORWARD/SERVING ONLY — training and the
+    # streaming tick keep the XLA kernel (the parity oracle). Off-TPU the
+    # kernel runs in interpret mode (tier-1 CPU tests exercise it so).
+    gnn_pallas: bool = False
     llm_provider: str = "none"                     # none|gemini|openai|ollama
     llm_api_key: str = ""
     llm_model: str = ""

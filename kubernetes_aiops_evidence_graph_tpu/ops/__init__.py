@@ -1,3 +1,4 @@
+from .pallas_segment import pallas_gather_matmul_segment
 from .propagate import k_hop_reach, propagate_labels
 from .segment import (
     gather_matmul_segment,
@@ -10,5 +11,5 @@ from .segment import (
 __all__ = [
     "k_hop_reach", "propagate_labels",
     "scatter_add", "scatter_add_2d", "scatter_max", "gather_neighbors",
-    "gather_matmul_segment",
+    "gather_matmul_segment", "pallas_gather_matmul_segment",
 ]

@@ -1,0 +1,206 @@
+"""Pallas TPU kernel for the relation-bucketed message-passing hot path.
+
+BENCH round 5 measured the 50k-node GNN forward at 7.8% of its bandwidth
+roofline (41.0 ms/forward, 49.6 GB/s achieved on a 635.8 GB/s part) while
+the rules scan on the same chip hit 91%. The gap is the XLA lowering of
+``ops.gather_matmul_segment``: the per-slice ``[E_r, H]`` gather rows and
+the per-edge scatter-adds both stream through HBM at random-row
+efficiency, and the ``[E_r, H]`` message table is materialized to HBM
+between the matmul and the segment-sum.
+
+``pallas_gather_matmul_segment`` maps the SAME math (fused gather →
+per-relation ``[H, H]`` matmul → dst-segment accumulation over the static
+relation-bucketed edge layout) onto one tiled, VMEM-resident pipeline:
+
+* the node table ``h`` and the ``[N, K]`` accumulator live in VMEM for the
+  whole pass (the PR 1 layout shrank the gather table 9.4x to ``[Pn, H]``
+  — 16 MB at the bench config, small enough to sit next to the compute);
+  the accumulator is seeded from a host-side zeros input via
+  ``input_output_aliases`` so the kernel contains no init branch;
+* the grid streams ``EDGE_TILE``-row tiles of ``(src, dst, mask)`` — one
+  relation per tile, the per-tile relation id arrives via scalar prefetch
+  from a static table derived from ``rel_offsets``;
+* each tile gathers its source rows into a VMEM scratch, runs ONE
+  ``[EDGE_TILE, H] × [H, K]`` matmul on the MXU (compute-dtype operands,
+  f32 accumulation via ``preferred_element_type``) into a second scratch,
+  and accumulates the message rows into the resident accumulator —
+  per-edge ``+=`` against VMEM, never a per-edge HBM scatter-add, and no
+  ``[E_r, H]`` message table ever exists outside the tile.
+
+The per-edge accumulate applies updates in exact edge order — the same
+left-fold the XLA kernel's scatter-add performs — so the kernel is
+BIT-IDENTICAL to ``gather_matmul_segment`` on CPU (``interpret=True``),
+which is the parity contract tier-1 pins (tests/test_ops.py,
+tests/test_gnn_bucketed.py). Coalescing a sorted run into a register and
+flushing once was evaluated and rejected: it reassociates the fold
+whenever a dst recurs across relation slices, trading the bit-parity
+oracle for a micro-optimization the VMEM accumulator already made cheap.
+The sorted-by-``(rel, dst)`` layout (PR 1) still matters: it makes the
+accumulator walk mostly-sequential rows, so ``slices_sorted`` is kept in
+the signature for dispatch symmetry with the XLA kernel.
+
+House style follows ``experiments/pallas_rules.py``: static tables built
+host-side, ``interpret=True`` on CPU (auto-detected when not forced) so
+tier-1 stays hermetic, bit-parity tests against the XLA kernel. Forward/
+serving only — there is no custom_vjp here; training and gradients stay
+on the XLA bucketed kernel (``settings.gnn_pallas`` gates dispatch in
+``rca/gnn.py``).
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Edge rows per grid step. 64 divides every REL_SLICE_BUCKETS capacity
+# (powers of two >= 64, then 8192-multiples — graph/snapshot.py), so tiles
+# never straddle a relation slice and the per-tile relation id is a static
+# table. [64, H] keeps the MXU tile busy at H = 64 while the gather loop —
+# the true bottleneck — stays row-granular either way.
+EDGE_TILE = 64
+
+
+@lru_cache(maxsize=64)
+def _tile_rel_ids(rel_offsets: tuple[int, ...]) -> np.ndarray:
+    """Static per-tile relation ids: tile ``t`` covers edge rows
+    ``[t*EDGE_TILE, (t+1)*EDGE_TILE)`` and belongs to exactly one relation
+    slice (capacities are EDGE_TILE-aligned — checked by the caller)."""
+    rels: list[int] = []
+    for r in range(len(rel_offsets) - 1):
+        cap = int(rel_offsets[r + 1]) - int(rel_offsets[r])
+        rels.extend([r] * (cap // EDGE_TILE))
+    return np.asarray(rels, np.int32)
+
+
+def tiles_align(rel_offsets) -> bool:
+    """Whether every relation slice capacity is a multiple of EDGE_TILE
+    (true for any layout drawn from the REL_SLICE_BUCKETS ladder). The
+    dispatcher falls back to the XLA kernel otherwise."""
+    return all((int(hi) - int(lo)) % EDGE_TILE == 0
+               for lo, hi in zip(rel_offsets[:-1], rel_offsets[1:]))
+
+
+def _gms_kernel(rel_ref, acc_init_ref, h_ref, w_ref, src_ref, dst_ref,
+                mask_ref, out_ref, gath_ref, msg_ref):
+    """One edge tile: gather rows into VMEM scratch, one MXU matmul into
+    the message scratch, per-edge accumulate into the VMEM-resident
+    [N, K] output (seeded from acc_init via input/output aliasing —
+    ``acc_init_ref`` is never read here)."""
+    t = pl.program_id(0)
+
+    # gather this tile's source rows (masked: padding rows contribute
+    # exact zeros, matching the XLA kernel's mask-then-matmul)
+    def gather_row(e, _):
+        srow = src_ref[0, e]
+        gath_ref[e, :] = h_ref[srow, :] * mask_ref[0, e]
+        return 0
+
+    jax.lax.fori_loop(0, EDGE_TILE, gather_row, 0)
+
+    rel = rel_ref[t]
+    msg_ref[:] = jnp.dot(gath_ref[:], w_ref[rel],
+                         preferred_element_type=out_ref.dtype)
+
+    # per-edge accumulate against VMEM, in exact edge order — the same
+    # left-fold as the XLA scatter-add, hence bit-parity
+    def accum_row(e, _):
+        d = dst_ref[0, e]
+        out_ref[d, :] = out_ref[d, :] + msg_ref[e, :]
+        return 0
+
+    jax.lax.fori_loop(0, EDGE_TILE, accum_row, 0)
+
+
+def pallas_gather_matmul_segment(
+    h: jax.Array,              # [N, H] node table
+    w_rel: jax.Array,          # [R, H, K] per-relation transforms
+    src: jax.Array,            # [E] source index, relation-bucketed layout
+    dst: jax.Array,            # [E] destination/segment index
+    mask: jax.Array,           # [E] 1.0 live / 0.0 padding
+    rel_offsets: tuple[int, ...],   # [R+1] STATIC slice bounds into E
+    num_segments: int,
+    *,
+    slices_sorted: bool = False,
+    compute_dtype=None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Drop-in Pallas replacement for :func:`ops.segment.gather_matmul_segment`
+    (same signature, same semantics, bit-identical results — see the
+    module docstring for the tiling scheme). ``slices_sorted`` does not
+    change the math here (the VMEM accumulate is order-exact either way);
+    it is accepted so dispatch sites key both kernels identically.
+    ``interpret=None`` auto-selects interpret mode off-TPU so tier-1 CPU
+    tests exercise the kernel hermetically."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out_dtype = h.dtype
+    k = w_rel.shape[-1]
+    offs = tuple(int(o) for o in rel_offsets)
+    e_total = offs[-1] if offs else 0
+    if e_total == 0:
+        return jnp.zeros((num_segments, k), out_dtype)
+    if not tiles_align(offs):
+        # a layout off the EDGE_TILE-aligned ladder (hand-built tests,
+        # exotic configs): the XLA kernel handles any static slicing
+        from .segment import gather_matmul_segment
+        return gather_matmul_segment(
+            h, w_rel, src, dst, mask, offs, num_segments,
+            slices_sorted=slices_sorted, compute_dtype=compute_dtype)
+    if compute_dtype is not None:
+        # cast ONCE before the kernel, exactly like the XLA kernel: the
+        # gathered rows then move at compute-dtype width and the matmul
+        # still accumulates into out_dtype via preferred_element_type
+        h = h.astype(compute_dtype)
+        w_rel = w_rel.astype(compute_dtype)
+        mask = mask.astype(compute_dtype)
+    num_tiles = e_total // EDGE_TILE
+    rel_ids = jnp.asarray(_tile_rel_ids(offs))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles,),
+        in_specs=[
+            # accumulator seed (aliased to the output below) + node table
+            # + per-relation weights: VMEM-resident for the whole pass
+            # (constant index maps, so the blocks persist across grid
+            # steps instead of re-streaming from HBM)
+            pl.BlockSpec((num_segments, k), lambda t, rel_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(h.shape, lambda t, rel_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(w_rel.shape, lambda t, rel_ref: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            # edge tiles stream through: one (1, EDGE_TILE) block per step
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((num_segments, k),
+                               lambda t, rel_ref: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((EDGE_TILE, h.shape[1]), h.dtype),  # gathered rows
+            pltpu.VMEM((EDGE_TILE, k), out_dtype),     # message tile (f32)
+        ],
+    )
+    # the zeros seed aliases the output: the accumulator starts zeroed
+    # without any in-kernel init branch, and XLA can reuse the buffer
+    # in place (alias indices count the scalar-prefetch operand, so the
+    # seed — second overall operand — is index 1)
+    return pl.pallas_call(
+        _gms_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_segments, k), out_dtype),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(rel_ids, jnp.zeros((num_segments, k), out_dtype), h, w_rel,
+      jnp.reshape(src, (num_tiles, EDGE_TILE)),
+      jnp.reshape(dst, (num_tiles, EDGE_TILE)),
+      jnp.reshape(mask, (num_tiles, EDGE_TILE)))

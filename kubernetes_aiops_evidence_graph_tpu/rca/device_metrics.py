@@ -273,7 +273,8 @@ def measure_scan_per_pass_s(batch: DeviceBatch, device_args: tuple,
 
 def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
                                    k2: int = 16, bucketed: bool = False,
-                                   compute_dtype: str | None = None) -> float:
+                                   compute_dtype: str | None = None,
+                                   pallas: bool = False) -> float:
     """Device-only per-forward seconds of the full GNN (all layers), via a
     scanned forward whose input features are scaled by
     ``1 + mean_logit * 1e-38`` — exactly 1.0 in f32 (the product
@@ -284,10 +285,14 @@ def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
 
     ``bucketed=True`` times the relation-bucketed kernel on the
     snapshot's (rel, dst) layout (with the optional bf16
-    ``compute_dtype``); False times the transform-then-gather reference
-    on the same arrays — the two are directly comparable because both
-    consume identical inputs."""
+    ``compute_dtype``); ``pallas=True`` (implies bucketed) times the
+    tiled VMEM-resident Pallas tier instead — the bench's
+    pallas-vs-XLA A/B rides this flag; False times the
+    transform-then-gather reference on the same arrays — all variants
+    are directly comparable because they consume identical inputs."""
     from . import gnn
+    if pallas:
+        bucketed = True
     b = gnn.snapshot_batch(snapshot)
     args = tuple(jnp.asarray(b[key]) for key in (
         "features", "node_kind", "node_mask", "edge_src", "edge_dst",
@@ -300,17 +305,18 @@ def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
     slices_sorted = bool(offs) and gnn.slices_sorted_by_dst(
         b["edge_dst"], offs)
 
-    @partial(jax.jit, static_argnames=("k", "sorted_", "offs", "ss", "cd"))
+    @partial(jax.jit, static_argnames=("k", "sorted_", "offs", "ss", "cd",
+                                       "pal"))
     def scan_fwd(params, features, node_kind, node_mask, edge_src, edge_dst,
                  edge_rel, edge_mask, incident_nodes, k: int, sorted_: bool,
-                 offs, ss: bool, cd):
+                 offs, ss: bool, cd, pal: bool):
         def body(carry, _):
             f = features * (1.0 + carry * 1e-38)
             logits = gnn.forward(params, f, node_kind, node_mask,
                                  edge_src, edge_dst, edge_rel, edge_mask,
                                  incident_nodes, sorted_by_dst=sorted_,
                                  rel_offsets=offs, slices_sorted=ss,
-                                 compute_dtype=cd)
+                                 compute_dtype=cd, pallas=pal)
             return logits.mean(), None
         last, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=k)
         return last
@@ -318,7 +324,8 @@ def measure_gnn_forward_per_pass_s(params, snapshot, k1: int = 4,
     def run(k: int) -> float:
         t0 = time.perf_counter()
         out = scan_fwd(params, *args, k=k, sorted_=sorted_by_dst,
-                       offs=offs, ss=slices_sorted, cd=compute_dtype)
+                       offs=offs, ss=slices_sorted, cd=compute_dtype,
+                       pal=pallas)
         jax.device_get(out)
         return time.perf_counter() - t0
 

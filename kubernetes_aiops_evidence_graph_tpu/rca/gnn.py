@@ -12,8 +12,9 @@ a relation-blind mean blends them, and measurably confuses incident pairs
 sharing a deployment (round-4 holdout: every miss predicted its
 deployment-mate's rule).
 
-Two mappings of the per-relation math, selected by the snapshot layout
-(settings.gnn_bucketed is the escape hatch back to the reference):
+Three mappings of the per-relation math, selected by the snapshot layout
+and two settings flags (settings.gnn_bucketed is the escape hatch back to
+the reference; settings.gnn_pallas promotes serving to the Pallas tier):
 
 * **Relation-bucketed (the hot path)** — build_snapshot lays edges out
   sorted by (rel, dst) with a STATIC per-relation offset table, so each
@@ -36,6 +37,17 @@ Two mappings of the per-relation math, selected by the snapshot layout
   accumulation in the segment-sum. Measured numbers live in BENCH
   (bench.py reports reference vs bucketed vs bf16 on the same snapshot
   each run).
+* **Pallas tier (serving, behind settings.gnn_pallas)** — the same
+  relation-bucketed math as one tiled VMEM-resident kernel
+  (ops/pallas_segment.py): the node table and the [N, H] accumulator stay
+  in VMEM for the whole pass, edge tiles stream through with their
+  relation id scalar-prefetched, each tile runs one MXU matmul and
+  accumulates destination rows against VMEM instead of issuing per-edge
+  HBM scatter-adds. BIT-identical to the bucketed kernel (exact-edge-order
+  fold; interpret=True on CPU), forward/serving only — no custom_vjp, so
+  gradients and the training step stay on the XLA bucketed kernel, which
+  remains the parity oracle. BENCH config 3 carries the pallas-vs-XLA A/B
+  record (gnn_forward_pallas_vs_xla).
 * **Transform-then-gather (reference)** — R stacked MXU matmuls produce
   every relation's transformed copy ([N, R, H] einsum), each edge
   gathers its rel-specific source row, aggregation is one [E, H]
@@ -134,16 +146,21 @@ def _message_pass(h, layer, edge_src, edge_dst, edge_rel, edge_mask,
 
 def _message_pass_bucketed(h, layer, edge_src, edge_dst, edge_mask,
                            rel_offsets, inv_deg, slices_sorted: bool,
-                           compute_dtype):
+                           compute_dtype, use_pallas: bool = False):
     """One relation-aware round over the relation-bucketed edge layout
     (module docstring): the fused gather → per-relation matmul →
     per-slice segment-sum helper replaces both the dense [N, R, H]
     transform AND the [E, H] message materialization of the reference
     mapping. ``edge_rel`` is not consumed — the static slices imply the
     relation. ``compute_dtype`` (e.g. "bfloat16") casts matmul operands
-    only; accumulation stays f32."""
-    from ..ops.segment import gather_matmul_segment
-    agg = gather_matmul_segment(
+    only; accumulation stays f32. ``use_pallas`` swaps in the tiled
+    VMEM-resident Pallas kernel (bit-identical; forward-only — callers
+    that need gradients must leave it off)."""
+    if use_pallas:
+        from ..ops.pallas_segment import pallas_gather_matmul_segment as gms
+    else:
+        from ..ops.segment import gather_matmul_segment as gms
+    agg = gms(
         h, layer["w_rel"], edge_src, edge_dst, edge_mask, rel_offsets,
         h.shape[0], slices_sorted=slices_sorted,
         compute_dtype=compute_dtype) * inv_deg[:, None]
@@ -171,6 +188,7 @@ def forward(
     rel_offsets: tuple[int, ...] | None = None,
     slices_sorted: bool = False,
     compute_dtype: str | None = None,
+    pallas: bool = False,
 ) -> jax.Array:
     """Logits [B, NUM_CLASSES] for each incident node.
 
@@ -182,9 +200,14 @@ def forward(
       MUST be laid out per the snapshot's (rel, dst) contract).
       ``slices_sorted=True`` additionally promises dst is non-decreasing
       within each slice (build_snapshot guarantees it; the streaming
-      mirror, whose slots are reused under churn, must not).
+      mirror promises it only until its first in-place churn).
       ``compute_dtype`` (e.g. "bfloat16") casts matmul operands only —
       accumulation stays f32.
+    * ``pallas=True`` (requires ``rel_offsets``) dispatches the message
+      passing to the tiled VMEM-resident Pallas kernel — the serving
+      tier behind settings.gnn_pallas. Bit-identical logits; FORWARD
+      ONLY (no custom_vjp — gradients raise; training stays on the XLA
+      bucketed kernel). Off-TPU the kernel auto-selects interpret mode.
     * ``sorted_by_dst=True`` (reference path only) promises the WHOLE
       edge_dst is non-decreasing, letting every segment-sum take the
       sorted fast path (measured 1.9x on the v5e scatter). Only a
@@ -201,7 +224,8 @@ def forward(
         if rel_offsets is not None:
             h = _message_pass_bucketed(h, layer, edge_src, edge_dst,
                                        edge_mask, rel_offsets, inv_deg,
-                                       slices_sorted, compute_dtype)
+                                       slices_sorted, compute_dtype,
+                                       use_pallas=pallas)
         else:
             h = _message_pass(h, layer, edge_src, edge_dst, edge_rel,
                               edge_mask, inv_deg,
@@ -285,22 +309,25 @@ _jit_forward = None
 
 
 def forward_batch(params: Params, batch: dict, *, bucketed: bool = True,
-                  compute_dtype: str | None = None) -> jax.Array:
+                  compute_dtype: str | None = None,
+                  pallas: bool = False) -> jax.Array:
     """Score one snapshot batch with the best kernel for its layout.
 
     One shared dispatcher (gnn_backend, the trainer's eval paths and the
     oracle crosscheck all route through it): batches carrying a
     ``rel_offsets`` tuple take the relation-bucketed kernel (with the
-    per-slice sorted fast path when the layout satisfies it); everything
-    else — including ``bucketed=False``, the reference escape hatch —
-    takes transform-then-gather with the global-sort fast path when the
-    layout allows. All variants share ONE jitted callable keyed on the
-    static args."""
+    per-slice sorted fast path when the layout satisfies it), promoted to
+    the Pallas serving tier when ``pallas=True`` (settings.gnn_pallas —
+    forward-only, bit-identical); everything else — including
+    ``bucketed=False``, the reference escape hatch — takes
+    transform-then-gather with the global-sort fast path when the layout
+    allows. All variants share ONE jitted callable keyed on the static
+    args."""
     global _jit_forward
     if _jit_forward is None:
         _jit_forward = jax.jit(forward, static_argnames=(
             "sorted_by_dst", "rel_offsets", "slices_sorted",
-            "compute_dtype"))
+            "compute_dtype", "pallas"))
     args = (params, batch["features"], batch["node_kind"],
             batch["node_mask"], batch["edge_src"], batch["edge_dst"],
             batch["edge_rel"], batch["edge_mask"], batch["incident_nodes"])
@@ -309,7 +336,7 @@ def forward_batch(params: Params, batch: dict, *, bucketed: bool = True,
         return _jit_forward(
             *args, rel_offsets=offs,
             slices_sorted=slices_sorted_by_dst(batch["edge_dst"], offs),
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, pallas=pallas)
     return _jit_forward(
         *args, sorted_by_dst=edges_sorted_by_dst(batch["edge_dst"]))
 

@@ -72,12 +72,18 @@ class GnnRcaBackend:
         # O(E) scan, noise next to tensorization.
         self._bucketed = bool(getattr(cfg, "gnn_bucketed", True))
         self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
+        # settings.gnn_pallas promotes snapshot scoring to the tiled
+        # VMEM-resident Pallas kernel (ops/pallas_segment.py) — forward
+        # only, bit-identical to the bucketed kernel; training and the
+        # streaming tick stay on the XLA path
+        self._pallas = bool(getattr(cfg, "gnn_pallas", False))
 
     def score_snapshot(self, snapshot) -> dict:
         """Same keys as TpuRcaBackend.score_snapshot where meaningful."""
         b = gnn.snapshot_batch(snapshot)
         logits = gnn.forward_batch(self.params, b, bucketed=self._bucketed,
-                                   compute_dtype=self._compute_dtype)
+                                   compute_dtype=self._compute_dtype,
+                                   pallas=self._pallas)
         probs = np.asarray(jax.device_get(jax.nn.softmax(logits, axis=-1)))
         n = snapshot.num_incidents
         pred = probs.argmax(axis=-1)

@@ -11,8 +11,11 @@ relation-bucketed layout as `build_snapshot` (static per-relation slice
 offsets; see _mirror_init) so the tick runs the E-scaled bucketed kernel
 — slots allocate from per-relation free lists, which keeps the static
 offsets valid under churn, with a full re-mirror as the region-overflow
-fallback. Within-slice dst order is NOT maintained under churn, so the
-tick never claims the sorted-scatter fast path.
+fallback. A full re-mirror additionally emits each slice dst-SORTED
+(padding pinned to the last row), so post-rebuild ticks claim the
+sorted-scatter fast path (`slices_sorted=True`) until the first in-place
+edge churn reuses a slot and forfeits it — the promise is a per-state
+fact tracked in `_slices_sorted`, not a hardcoded slow path.
 
 Why a full re-embed per tick (not dirty-subgraph re-embedding): the GNN
 forward is measured cheap at serving scale — a 3-layer forward over the
@@ -140,15 +143,19 @@ class GnnStreamingScorer(StreamingScorer):
         self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
         super().__init__(store, settings, mesh=mesh)
 
-    def _tick_statics(self, rel_offsets=None) -> dict:
-        """Static kwargs for _gnn_tick under the current mode. Slot reuse
-        under churn breaks within-slice dst order, so the mirror never
-        promises slices_sorted — the bucketed win here is the E-scaled
-        traffic, not the sorted scatter."""
+    def _tick_statics(self, rel_offsets=None, slices_sorted=None) -> dict:
+        """Static kwargs for _gnn_tick under the current mode. A fresh
+        re-mirror lays every slice out dst-sorted, so post-rebuild ticks
+        claim the sorted-scatter fast path; the first in-place edge churn
+        reuses a slot, breaks within-slice order, and flips
+        `_slices_sorted` off until the next re-mirror
+        (_packed_gnn_delta). ``slices_sorted`` overrides the tracked
+        state for warm pre-compiles of a specific variant."""
         offs = rel_offsets if rel_offsets is not None else self._rel_offsets
+        ss = self._slices_sorted if slices_sorted is None else slices_sorted
         return {
             "rel_offsets": offs if self._use_bucketed else None,
-            "slices_sorted": False,
+            "slices_sorted": bool(ss) if self._use_bucketed else False,
             "compute_dtype": self._compute_dtype if self._use_bucketed
             else None,
         }
@@ -185,26 +192,37 @@ class GnnStreamingScorer(StreamingScorer):
         resolving rows through the base scorer's CURRENT id->row map
         (NOT a fresh snapshot: rows must match the resident features).
 
-        Relation-bucketed layout (graph/snapshot.py contract, minus the
-        within-slice dst sort — slot reuse under churn destroys it
-        anyway): relation r owns slice [off[r], off[r+1]) of the edge
-        arrays, slots allocate in (fwd, rev) pairs from their OWN
-        region's free list, so the static offset table stays valid under
-        arbitrary churn; a region running out of pairs falls back to a
-        full re-mirror with re-derived capacities (counted in stats via
-        the journal-truncation/rebuild paths that also call this)."""
+        Relation-bucketed layout (the full graph/snapshot.py contract,
+        INCLUDING the within-slice dst sort): relation r owns slice
+        [off[r], off[r+1]) of the edge arrays; a re-mirror emits each
+        slice's directed edges sorted by dst with padding pinned to the
+        last node row, so the freshly-built layout satisfies the
+        per-slice sorted promise and `_slices_sorted` flips on. Under
+        churn, directed slots allocate individually from their OWN
+        region's free list (sorting decouples an edge's fwd/rev entries,
+        so slots are no longer adjacent pairs), which keeps the static
+        offset table valid under arbitrary churn but forfeits the sorted
+        promise at the first in-place delta; a region running out of
+        slots falls back to a full re-mirror with re-derived capacities
+        (counted in stats via the journal-truncation/rebuild paths that
+        also call this)."""
         from ..graph.schema import RelationKind
         offs = self._mirror_offsets_now()
         num_rels = len(RelationKind)
         pe = max(int(offs[-1]), 1)
+        pn = self.snapshot.padded_nodes
         _, edges = self.store._raw()
         esrc = np.zeros(pe, np.int32)
-        edst = np.zeros(pe, np.int32)
+        # padding dst pinned to the last row (as build_snapshot does) so
+        # the tail of every slice keeps the sorted promise; masks zero it
+        edst = np.full(pe, pn - 1, np.int32)
         erel = np.full(pe, -1, np.int32)
         emask = np.zeros(pe, np.float32)
-        self._edge_slot: dict[_EdgeKey, int] = {}
+        self._edge_slot: dict[_EdgeKey, tuple[int, int]] = {}
         self._node_edges: dict[str, set[_EdgeKey]] = {}
-        fill = [int(offs[r]) for r in range(num_rels)]
+        # (dst_row, src_row, key, is_fwd) per relation, then dst-sorted
+        directed: list[list[tuple[int, int, _EdgeKey, bool]]] = [
+            [] for _ in range(num_rels)]
         for e in edges:
             srow = self._id_to_idx.get(e.src)
             drow = self._id_to_idx.get(e.dst)
@@ -212,18 +230,26 @@ class GnnStreamingScorer(StreamingScorer):
                 continue
             key = (e.src, e.dst, int(e.kind))
             r = int(e.kind)
-            slot = fill[r]
-            fill[r] += 2
-            esrc[slot], edst[slot], emask[slot] = srow, drow, 1.0
-            esrc[slot + 1], edst[slot + 1], emask[slot + 1] = drow, srow, 1.0
-            erel[slot] = erel[slot + 1] = r
-            self._edge_slot[key] = slot
+            directed[r].append((drow, srow, key, True))
+            directed[r].append((srow, drow, key, False))
             self._node_edges.setdefault(e.src, set()).add(key)
             self._node_edges.setdefault(e.dst, set()).add(key)
+        fill = [int(offs[r]) for r in range(num_rels)]
+        slots_by_key: dict[_EdgeKey, dict[bool, int]] = {}
+        for r in range(num_rels):
+            directed[r].sort(key=lambda t: t[0])   # stable: dst only
+            for drow, srow, key, fwd in directed[r]:
+                slot = fill[r]
+                fill[r] += 1
+                esrc[slot], edst[slot], emask[slot] = srow, drow, 1.0
+                erel[slot] = r
+                slots_by_key.setdefault(key, {})[fwd] = slot
+        for key, by_dir in slots_by_key.items():
+            self._edge_slot[key] = (by_dir[True], by_dir[False])
         self._rel_offsets: tuple[int, ...] = offs
-        # per-relation free pair lists (slot allocation stays region-local)
+        # per-relation free slot lists (allocation stays region-local)
         self._free_edge_slots: list[list[int]] = [
-            list(range(int(offs[r + 1]) - 2, fill[r] - 2, -2))
+            list(range(int(offs[r + 1]) - 1, fill[r] - 1, -1))
             for r in range(num_rels)]
         self._esrc_dev = jnp.asarray(esrc)
         self._edst_dev = jnp.asarray(edst)
@@ -231,8 +257,11 @@ class GnnStreamingScorer(StreamingScorer):
         self._emask_dev = jnp.asarray(emask)
         self._kind_dev = jnp.asarray(self.snapshot.node_kind)
         self._nmask_dev = jnp.asarray(self.snapshot.node_mask)
-        # slot -> (src_row, dst_row, rel_kind, mask)
+        # directed slot -> (src_row, dst_row, rel_kind, mask)
         self._pending_edges: dict[int, tuple[int, int, int, int]] = {}
+        # a fresh re-mirror IS dst-sorted per slice; in-place churn
+        # (_packed_gnn_delta) forfeits the promise until the next one
+        self._slices_sorted = True
         self._last_gnn: tuple | None = None
 
     # -- journal-driven mirror maintenance --------------------------------
@@ -246,21 +275,22 @@ class GnnStreamingScorer(StreamingScorer):
         if srow is None or drow is None:
             return   # endpoint removed later in this batch: edge is gone too
         free = self._free_edge_slots[kind]
-        if not free:
+        if len(free) < 2:
             # this relation's region overflowed: full re-mirror with
             # re-derived capacities (the bucketed-layout fallback — the
             # static offsets can't stretch in place)
             self._mirror_init()
             return
-        slot = free.pop()
-        self._edge_slot[key] = slot
+        slot_f, slot_r = free.pop(), free.pop()
+        self._edge_slot[key] = (slot_f, slot_r)
         self._node_edges.setdefault(src, set()).add(key)
         self._node_edges.setdefault(dst, set()).add(key)
-        self._pending_edges[slot] = (srow, drow, kind, 1)
+        self._pending_edges[slot_f] = (srow, drow, kind, 1)
+        self._pending_edges[slot_r] = (drow, srow, kind, 1)
 
     def _mirror_del(self, key: _EdgeKey) -> None:
-        slot = self._edge_slot.pop(key, None)
-        if slot is None:
+        slots = self._edge_slot.pop(key, None)
+        if slots is None:
             return
         src, dst, kind = key
         for nid in (src, dst):
@@ -269,8 +299,9 @@ class GnnStreamingScorer(StreamingScorer):
                 s.discard(key)
                 if not s:
                     del self._node_edges[nid]
-        self._free_edge_slots[kind].append(slot)   # back to ITS region
-        self._pending_edges[slot] = (0, 0, -1, 0)
+        for slot in slots:
+            self._free_edge_slots[kind].append(slot)   # back to ITS region
+            self._pending_edges[slot] = (0, 0, -1, 0)
 
     def _drain_edges(self) -> None:
         recs, seq, truncated = self.store.journal_since(self._gnn_seq)
@@ -309,10 +340,8 @@ class GnnStreamingScorer(StreamingScorer):
             nmask_v[:len(aux_rows)] = self.snapshot.node_mask[
                 aux_rows].astype(np.int32)
 
-        ents = []
-        for slot, (srow, drow, rel, m) in self._pending_edges.items():
-            ents.append((slot, srow, drow, rel, m))       # forward direction
-            ents.append((slot + 1, drow, srow, rel, m))   # reverse direction
+        ents = [(slot, srow, drow, rel, m)
+                for slot, (srow, drow, rel, m) in self._pending_edges.items()]
         self._pending_edges = {}
         if len(ents) > _DELTA_BUCKETS[-1]:
             # a delta beyond the ladder would mint a fresh power-of-two
@@ -324,6 +353,11 @@ class GnnStreamingScorer(StreamingScorer):
             # padding sentinel below must be out of range of the NEW pe,
             # or it would zero a live slot (code-review r5)
             pe = int(self._esrc_dev.shape[0])
+        if ents:
+            # applying an in-place edge delta reuses slots out of dst
+            # order: the sorted fast path is forfeit until the next full
+            # re-mirror re-establishes it
+            self._slices_sorted = False
         ek = bucket_for(max(len(ents), 1), _DELTA_BUCKETS)
         e_idx = np.full(ek, pe, np.int32)
         e_src = np.zeros(ek, np.int32)
@@ -392,7 +426,10 @@ class GnnStreamingScorer(StreamingScorer):
         directed entries, so a coalesced churn tick touching >128 edges
         lands in that bucket — the serving bench does, and a mid-serve
         compile there is the exact hiccup this exists to prevent
-        (code-review r5). All-dropped deltas: read-only, resident handles kept.
+        (code-review r5). Both sorted variants are warmed: fresh-mirror /
+        post-rebuild ticks claim slices_sorted=True, the first in-place
+        churn flips to False — neither transition may pay a mid-serve
+        compile. All-dropped deltas: read-only, resident handles kept.
         The handles are captured under serve_lock — a concurrent rebuild
         swapping them one attribute at a time must not hand jit a mixed
         old/new shape set (same reason as base warm(), streaming.py)."""
@@ -403,23 +440,25 @@ class GnnStreamingScorer(StreamingScorer):
             handles = (self._params, self._features_dev, self._kind_dev,
                        self._nmask_dev, self._esrc_dev, self._edst_dev,
                        self._erel_dev, self._emask_dev)
-            statics = self._tick_statics()
+            variants = [self._tick_statics(slices_sorted=ss) for ss in
+                        ((True, False) if self._use_bucketed else (False,))]
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
             inc_m = self.snapshot.incident_mask.astype(np.int32)
-        for pk in delta_sizes:
-            for ek in edge_sizes:
-                if self._warm_stop:
-                    return
-                ints = np.concatenate([
-                    np.full(pk, pn, np.int32), np.zeros(pk, np.int32),
-                    np.zeros(pk, np.int32),
-                    np.full(ek, pe, np.int32), np.zeros(ek, np.int32),
-                    np.zeros(ek, np.int32), np.full(ek, -1, np.int32),
-                    np.zeros(ek, np.int32),
-                    inc_n, inc_m,
-                ]).astype(np.int32, copy=False)
-                _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek, pi=pi,
-                          **statics)
+        for statics in variants:
+            for pk in delta_sizes:
+                for ek in edge_sizes:
+                    if self._warm_stop:
+                        return
+                    ints = np.concatenate([
+                        np.full(pk, pn, np.int32), np.zeros(pk, np.int32),
+                        np.zeros(pk, np.int32),
+                        np.full(ek, pe, np.int32), np.zeros(ek, np.int32),
+                        np.zeros(ek, np.int32), np.full(ek, -1, np.int32),
+                        np.zeros(ek, np.int32),
+                        inc_n, inc_m,
+                    ]).astype(np.int32, copy=False)
+                    _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek,
+                              pi=pi, **statics)
 
     def warm_growth(self) -> None:
         """Base growth shapes, then the GNN tick at every (pn, offsets,
@@ -433,7 +472,8 @@ class GnnStreamingScorer(StreamingScorer):
         (_mirror_offsets_now — the same derivation the rebuild runs);
         per-relation next-bucket combos are deliberately not enumerated,
         the combinatorics would swamp the warm budget for a rare single
-        compile."""
+        compile. Post-rebuild ticks run on a freshly dst-sorted mirror,
+        so the sorted variant is what gets warmed here."""
         super().warm_growth()
         shapes = {(cpn, cpi) for cpn, cpi, _w, _pw, _d
                   in self._growth_shape_combos()}
@@ -464,7 +504,8 @@ class GnnStreamingScorer(StreamingScorer):
                           jnp.full((cpe,), -1, jnp.int32),
                           jnp.zeros(cpe, jnp.float32),
                           jnp.asarray(ints), pk=pk, ek=ek, pi=cpi,
-                          **self._tick_statics(rel_offsets=offs))
+                          **self._tick_statics(rel_offsets=offs,
+                                               slices_sorted=True))
 
     def warm_serving(self) -> None:
         super().warm_serving()

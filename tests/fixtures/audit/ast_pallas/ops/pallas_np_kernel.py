@@ -1,0 +1,21 @@
+"""Seeded violation: `np.*` inside a `pl.pallas_call` kernel body —
+kernel bodies are traced code (refs and scalars are traced values), so
+the lint must trip exactly `np-in-traced` inside them."""
+import numpy as np
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _np_scale_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * np.float32(2.0)   # host numpy inside a kernel
+
+
+def np_in_kernel(x):
+    return pl.pallas_call(
+        _np_scale_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(x)

@@ -1,0 +1,25 @@
+"""Seeded violation: a jitted Pallas wrapper in a hot dir whose
+static/donate signature is NOT declared in
+analysis.ast_lint.JIT_DECLARATIONS — must trip exactly `jit-undeclared`
+(a new pallas entrypoint cannot land without registering its signature
+and, if hot, a jaxpr-audit entrypoint)."""
+from functools import partial
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _double_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def undeclared_pallas_entry(x, interpret: bool = False):
+    return pl.pallas_call(
+        _double_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x)

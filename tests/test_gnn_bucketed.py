@@ -54,6 +54,45 @@ def test_grad_parity_bucketed_vs_reference(world_batch):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_forward_parity_pallas_vs_bucketed(world_batch):
+    """The Pallas serving tier (interpret mode on CPU) is BIT-identical
+    to the XLA bucketed kernel: same edge-order left-fold, so the full
+    forward's logits match exactly — not just within float tolerance."""
+    params, b, _ = world_batch
+    assert b["rel_offsets"], "snapshot should carry the bucketed layout"
+    l_buck = np.asarray(gnn.forward_batch(params, b))
+    l_pal = np.asarray(gnn.forward_batch(params, b, pallas=True))
+    assert np.array_equal(l_pal, l_buck), \
+        float(np.abs(l_pal - l_buck).max())
+
+
+def test_bf16_pallas_path_within_bucketed_tolerance(world_batch):
+    """bf16 operands through the Pallas tier: f32 output, within the
+    same tolerance the bucketed bf16 path is held to."""
+    params, b, _ = world_batch
+    l_f32 = np.asarray(gnn.forward_batch(params, b))
+    l_pal = np.asarray(gnn.forward_batch(params, b, pallas=True,
+                                         compute_dtype="bfloat16"))
+    assert l_pal.dtype == np.float32
+    np.testing.assert_allclose(l_pal, l_f32, rtol=0.05, atol=0.05)
+
+
+def test_backend_flag_selects_pallas(world_batch):
+    """settings.gnn_pallas=True promotes snapshot scoring to the Pallas
+    tier — identical result surface, bit-identical probs."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import GnnRcaBackend
+    params, _, snap = world_batch
+    xla = GnnRcaBackend(params=params,
+                        settings=load_settings(gnn_pallas=False))
+    pal = GnnRcaBackend(params=params,
+                        settings=load_settings(gnn_pallas=True))
+    assert pal._pallas and not xla._pallas
+    r_xla = xla.score_snapshot(snap)
+    r_pal = pal.score_snapshot(snap)
+    np.testing.assert_array_equal(r_pal["probs"], r_xla["probs"])
+    assert (r_pal["top_rule_index"] == r_xla["top_rule_index"]).all()
+
+
 def test_bf16_compute_path_close_and_distinct(world_batch):
     """bf16 matmul operands with f32 accumulation: close to f32 (loose
     tolerance — one bf16 rounding per product term) and top-1 stable on

@@ -193,9 +193,10 @@ def test_overflow_remirror_sentinel_tracks_new_pe(params, monkeypatch):
     assert len(rels) > need > 0, "world too small to overflow the bucket"
     builder.store.upsert_relations(rels[:need])
 
-    # a tiny ladder makes any 5-pair delta overflow it
+    # a tiny ladder makes any 9-slot delta overflow it (pending entries
+    # are per directed slot)
     monkeypatch.setattr(gs, "_DELTA_BUCKETS", (4, 8))
-    scorer._pending_edges = {s: (0, 1, 0, 1) for s in (0, 2, 4, 6, 8)}
+    scorer._pending_edges = {s: (0, 1, 0, 1) for s in range(9)}
     ints, pk, ek = scorer._packed_gnn_delta([])
     pe_new = int(scorer._esrc_dev.shape[0])
     assert pe_new > pe_old, "re-mirror should have re-bucketed"
@@ -212,9 +213,10 @@ def _assert_bucketed_layout_valid(scorer):
     erel = np.asarray(scorer._erel_dev)
     emask = np.asarray(scorer._emask_dev)
     assert int(offs[-1]) == erel.shape[0]
-    for (_, _, kind), slot in scorer._edge_slot.items():
-        assert offs[kind] <= slot < slot + 1 < offs[kind + 1], \
-            f"slot pair {slot} escaped region {kind}"
+    for (_, _, kind), slots in scorer._edge_slot.items():
+        for slot in slots:
+            assert offs[kind] <= slot < offs[kind + 1], \
+                f"slot {slot} escaped region {kind}"
     live = emask > 0
     for r in range(len(offs) - 1):
         sl = slice(int(offs[r]), int(offs[r + 1]))
@@ -272,6 +274,49 @@ def test_mirror_region_overflow_falls_back_to_remirror(params):
             want.add((d, s))
     scorer.dispatch()
     assert scorer.mirror_edge_rows() == want
+
+
+def test_remirror_reclaims_sorted_fast_path(params):
+    """graft-pallas satellite: a full re-mirror emits dst-sorted slices
+    (padding pinned to the last row), so post-rebuild ticks claim
+    slices_sorted=True; the first in-place edge churn forfeits it; the
+    next re-mirror reclaims it. The claim must always match the actual
+    resident arrays (gnn.slices_sorted_by_dst)."""
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphRelation
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+
+    _, builder, _ = _world(num_pods=100)
+    scorer = GnnStreamingScorer(builder.store, SMALL, params=params)
+    assert scorer._slices_sorted, "a fresh mirror must claim the fast path"
+    assert gnn.slices_sorted_by_dst(np.asarray(scorer._edst_dev),
+                                    scorer._rel_offsets)
+    assert scorer._tick_statics()["slices_sorted"] is True
+
+    scorer.rescore()   # feature-only ticks keep the promise
+    assert scorer._slices_sorted
+
+    # one in-place edge add (a CALLS pair not yet mirrored) forfeits it
+    svcs = sorted(n for n in scorer._id_to_idx if n.startswith("service:"))
+    pods = sorted(n for n in scorer._id_to_idx if n.startswith("pod:"))
+    from kubernetes_aiops_evidence_graph_tpu.graph.schema import RelationKind
+    kind = int(RelationKind.CALLS)
+    pair = next((s, p) for s in svcs for p in pods
+                if (s, p, kind) not in scorer._edge_slot)
+    builder.store.upsert_relations([GraphRelation(
+        source_id=pair[0], target_id=pair[1], relation_type="CALLS")])
+    scorer.dispatch()
+    assert not scorer._slices_sorted, \
+        "an in-place edge delta must forfeit the sorted promise"
+    assert scorer._tick_statics()["slices_sorted"] is False
+
+    # the rebuild path (journal truncation / region overflow) reclaims it
+    scorer._mirror_init()
+    assert scorer._slices_sorted
+    assert gnn.slices_sorted_by_dst(np.asarray(scorer._edst_dev),
+                                    scorer._rel_offsets)
+    mine = scorer.rescore()
+    cold, _ = _cold_raw(builder.store, SMALL, params)
+    _assert_parity(mine, cold)
 
 
 def test_warm_paths_compile_without_touching_state(params):

@@ -69,6 +69,35 @@ def test_gather_matmul_segment_dot_flops_match_closed_form():
     assert bf16.hbm_bytes < cost.hbm_bytes
 
 
+def test_pallas_gather_matmul_segment_dot_flops_match_closed_form():
+    """The Pallas tier does the SAME math, tiled: grid-weighting the
+    kernel body (one [EDGE_TILE, H] x [H, H] dot per grid step) must
+    reproduce Σ_r 2·rows_r·H² exactly at the pallas canonical shapes —
+    the cost model's pallas_call handling is only trustworthy if it
+    lands on the identical closed form as the XLA kernel's."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        PALLAS_REL_COUNTS, PALLAS_TILE_BUDGET)
+    from kubernetes_aiops_evidence_graph_tpu.graph.snapshot import (
+        rel_slice_offsets)
+    offs = rel_slice_offsets(PALLAS_REL_COUNTS)
+    rows = [int(offs[r + 1] - offs[r]) for r in range(len(offs) - 1)]
+    want = sum(2 * r * HIDDEN * HIDDEN for r in rows)
+    cost = cost_entrypoint(BY_NAME["ops.pallas_gather_matmul_segment"])
+    assert cost.dot_flops == want
+    bf16 = cost_entrypoint(BY_NAME["ops.pallas_gather_matmul_segment.bf16"])
+    assert bf16.dot_flops == want
+    assert bf16.hbm_bytes < cost.hbm_bytes
+    # the VMEM-tile byte budget genuinely separates scales: the [N, H]
+    # accumulator fits, a single full-slice [E_r, H] materialization
+    # does not (that is the XLA kernel's working set, not the tile's)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        HIDDEN as H, PALLAS_N)
+    assert PALLAS_N * H * 4 <= PALLAS_TILE_BUDGET
+    assert max(rows) * H * 4 > PALLAS_TILE_BUDGET
+    # the registered jaxpr actually honors it (no slice-scale eqn output)
+    assert cost.peak_intermediate_bytes < max(rows) * H * 4 * 2
+
+
 def test_ring_collective_census_matches_its_spec_arithmetic():
     """The traced ring halo moves exactly (LAYERS+1)·D ppermutes of
     [N/D, H] f32 blocks and zero all-gathers — the contract the CostSpec
@@ -173,6 +202,21 @@ def test_every_entrypoint_name_appears_in_parity_table():
         f"PARITY.md cost table is missing entrypoints: {missing}"
 
 
+def test_parity_and_readme_document_the_pallas_ab():
+    """graft-pallas doc drift guard (same shape as the cost-table guard
+    above): PARITY.md must carry the pallas-vs-XLA roofline A/B row and
+    README the `gnn_pallas` flag with the interpret-on-CPU caveat."""
+    root = Path(__file__).parent.parent
+    parity = (root / "PARITY.md").read_text()
+    for needle in ("gnn_forward_pallas_vs_xla", "roofline_pct",
+                   "settings.gnn_pallas"):
+        assert needle in parity, f"PARITY.md lost the A/B row: {needle}"
+    readme = (root / "README.md").read_text()
+    assert "gnn_pallas" in readme, "README must document the flag"
+    assert "interpret" in readme, \
+        "README must note the interpret-mode-on-CPU caveat for tier-1"
+
+
 def test_registry_pins_the_collective_contracts():
     ring = BY_NAME["sharded_gnn.loss.ring.bucketed"].cost
     assert "all_gather" in ring.forbid
@@ -182,8 +226,14 @@ def test_registry_pins_the_collective_contracts():
     ag = BY_NAME["sharded_gnn.loss.allgather.bucketed"].cost
     assert ag.expect_counts["all_gather"] == LAYERS + 1
     assert ag.max_total_bytes is not None and ring.max_total_bytes is not None
-    # every single-device entrypoint keeps the no-collectives default
+    # every single-device entrypoint bans all collectives: either the
+    # implicit default (cost=None) or — for the pallas tier, where the
+    # acceptance contract pins it explicitly — COST_DEFAULT itself
     for e in ENTRYPOINTS:
         if not e.name.startswith("sharded_gnn."):
-            assert e.cost is None, e.name
+            assert e.cost is None or e.cost is COST_DEFAULT, e.name
+    for name in ("ops.pallas_gather_matmul_segment",
+                 "ops.pallas_gather_matmul_segment.bf16",
+                 "gnn.forward.bucketed.pallas"):
+        assert BY_NAME[name].cost is COST_DEFAULT, name
     assert set(COST_DEFAULT.forbid) == set(COLLECTIVE_PRIMS)

@@ -1,9 +1,19 @@
 import numpy as np
+import pytest
+
 import jax.numpy as jnp
 
 from kubernetes_aiops_evidence_graph_tpu.ops import (
-    k_hop_reach, propagate_labels, scatter_add, scatter_max,
+    gather_matmul_segment, k_hop_reach, pallas_gather_matmul_segment,
+    propagate_labels, scatter_add, scatter_max,
 )
+
+# the two relation-bucketed kernels share one semantics contract: every
+# edge-case test below runs against both (the XLA kernel is the parity
+# oracle; the Pallas tier runs interpret=True on CPU — tier-1 stays
+# hermetic, see ops/pallas_segment.py)
+GMS_KERNELS = {"xla": gather_matmul_segment,
+               "pallas": pallas_gather_matmul_segment}
 
 
 def _chain_edges():
@@ -12,6 +22,163 @@ def _chain_edges():
     dst = np.array([1, 0, 2, 1, 3, 2, 0, 0], dtype=np.int32)
     mask = np.array([1, 1, 1, 1, 1, 1, 0, 0], dtype=np.float32)  # 2 padded
     return src, dst, mask
+
+
+def _numpy_gms(h, w_rel, src, dst, mask, offs, num_segments):
+    """Independent f64 oracle for gather_matmul_segment semantics."""
+    out = np.zeros((num_segments, w_rel.shape[-1]), np.float64)
+    for r in range(len(offs) - 1):
+        wr = w_rel[r].astype(np.float64)
+        for e in range(int(offs[r]), int(offs[r + 1])):
+            out[dst[e]] += (h[src[e]].astype(np.float64) * mask[e]) @ wr
+    return out
+
+
+def _bucketed_layout(seed, caps, live, n=33, h=8, k=8, sort_dst=True):
+    """Random relation-bucketed edge layout honoring the snapshot
+    contract: live prefix per slice (dst-sorted when ``sort_dst``),
+    padding dst pinned to the last node row, mask zeroed. ``caps`` are
+    EDGE_TILE-multiples (or 0) like the real bucket ladder, so the
+    Pallas kernel takes its tiled path rather than the XLA fallback."""
+    rng = np.random.default_rng(seed)
+    offs = (0,) + tuple(int(c) for c in np.cumsum(caps))
+    pe = offs[-1]
+    src = rng.integers(0, n, pe).astype(np.int32)
+    dst = np.full(pe, n - 1, np.int32)
+    mask = np.zeros(pe, np.float32)
+    for r, c in enumerate(live):
+        lo = offs[r]
+        d = rng.integers(0, n, c).astype(np.int32)
+        dst[lo:lo + c] = np.sort(d) if sort_dst else d
+        mask[lo:lo + c] = 1.0
+    hmat = rng.standard_normal((n, h)).astype(np.float32)
+    w_rel = rng.standard_normal((len(caps), h, k)).astype(np.float32)
+    return (jnp.asarray(hmat), jnp.asarray(w_rel), jnp.asarray(src),
+            jnp.asarray(dst), jnp.asarray(mask), offs, n)
+
+
+@pytest.mark.parametrize("kernel", sorted(GMS_KERNELS))
+def test_gms_empty_and_allpadding_slices_match_oracle(kernel):
+    """Edge cases shared by both backends: a zero-width relation slice
+    (no edges of that kind), an all-padding slice (capacity allocated,
+    nothing live), and a normal live slice — against the f64 oracle."""
+    gms = GMS_KERNELS[kernel]
+    h, w, src, dst, mask, offs, n = _bucketed_layout(
+        seed=7, caps=(64, 0, 128), live=(5, 0, 37))
+    assert offs[2] - offs[1] == 0            # empty slice stays zero-width
+    out = np.asarray(gms(h, w, src, dst, mask, offs, n, slices_sorted=True))
+    want = _numpy_gms(np.asarray(h), np.asarray(w), np.asarray(src),
+                      np.asarray(dst), np.asarray(mask), offs, n)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    # all-padding EVERYWHERE: the kernel must return exact zeros
+    zero = np.asarray(gms(h, w, src, dst, jnp.zeros_like(mask), offs, n))
+    assert (zero == 0.0).all()
+
+
+@pytest.mark.parametrize("kernel", sorted(GMS_KERNELS))
+def test_gms_zero_total_capacity(kernel):
+    """offs == (0,)*R+1 (a graph with no edges at all) short-circuits to
+    a zeros accumulator of the right shape/dtype."""
+    gms = GMS_KERNELS[kernel]
+    h = jnp.ones((5, 8), jnp.float32)
+    w = jnp.ones((2, 8, 8), jnp.float32)
+    e = jnp.zeros((0,), jnp.int32)
+    out = np.asarray(gms(h, w, e, e, jnp.zeros((0,), jnp.float32),
+                         (0, 0, 0), 5))
+    assert out.shape == (5, 8) and out.dtype == np.float32
+    assert (out == 0.0).all()
+
+
+@pytest.mark.parametrize("kernel", sorted(GMS_KERNELS))
+def test_gms_bf16_operands_accumulate_f32_within_tolerance(kernel):
+    """compute_dtype=bfloat16 casts matmul operands only: output stays
+    f32 and tracks the f32 result within the bucketed-parity tolerance
+    (one bf16 rounding per product term)."""
+    gms = GMS_KERNELS[kernel]
+    h, w, src, dst, mask, offs, n = _bucketed_layout(
+        seed=11, caps=(64, 128), live=(41, 97))
+    f32 = np.asarray(gms(h, w, src, dst, mask, offs, n))
+    bf16 = np.asarray(gms(h, w, src, dst, mask, offs, n,
+                          compute_dtype=jnp.bfloat16))
+    assert bf16.dtype == np.float32
+    np.testing.assert_allclose(bf16, f32, rtol=0.05, atol=0.05)
+
+
+@pytest.mark.parametrize("kernel", sorted(GMS_KERNELS))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_gms_sorted_vs_unsorted_paths_equivalent(kernel, seed):
+    """Property test: the same edge SET laid out dst-sorted (claiming
+    slices_sorted=True) and shuffled-within-slice (claiming False) must
+    agree — the promise is a perf hint, never a semantics change. Float
+    tolerance: the per-dst fold order differs between layouts."""
+    gms = GMS_KERNELS[kernel]
+    h, w, src, dst, mask, offs, n = _bucketed_layout(
+        seed=seed, caps=(64, 64, 128), live=(23, 64, 59))
+    rng = np.random.default_rng(seed + 100)
+    src_u, dst_u = np.asarray(src).copy(), np.asarray(dst).copy()
+    mask_u = np.asarray(mask)
+    for r in range(len(offs) - 1):
+        lo, hi = offs[r], offs[r + 1]
+        perm = lo + rng.permutation(hi - lo)   # shuffle the WHOLE slice:
+        src_u[lo:hi] = src_u[perm]             # padding mixes in, mask
+        dst_u[lo:hi] = dst_u[perm]             # still zeroes it out
+        mask_u = mask_u.copy()
+        mask_u[lo:hi] = mask_u[perm]
+    a = np.asarray(gms(h, w, src, dst, mask, offs, n, slices_sorted=True))
+    b = np.asarray(gms(h, w, jnp.asarray(src_u), jnp.asarray(dst_u),
+                       jnp.asarray(mask_u), offs, n, slices_sorted=False))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_gms_bitparity_with_xla_kernel():
+    """The acceptance contract: interpret-mode Pallas output is
+    BIT-identical to the XLA bucketed kernel in f32 — same edge-order
+    left-fold, so not even reassociation noise — across sorted and
+    unsorted layouts, with empty and all-padding slices present."""
+    for seed, sort_dst in ((3, True), (4, False)):
+        h, w, src, dst, mask, offs, n = _bucketed_layout(
+            seed=seed, caps=(64, 0, 128, 64), live=(11, 0, 80, 0),
+            sort_dst=sort_dst)
+        a = np.asarray(gather_matmul_segment(
+            h, w, src, dst, mask, offs, n, slices_sorted=sort_dst))
+        b = np.asarray(pallas_gather_matmul_segment(
+            h, w, src, dst, mask, offs, n, slices_sorted=sort_dst,
+            interpret=True))
+        assert np.array_equal(a, b), float(np.abs(a - b).max())
+
+
+def test_pallas_gms_unaligned_layout_falls_back_to_xla():
+    """Slice capacities off the EDGE_TILE-aligned ladder (hand-built
+    layouts) route through the XLA kernel — same answer, no crash."""
+    from kubernetes_aiops_evidence_graph_tpu.ops.pallas_segment import (
+        EDGE_TILE, tiles_align)
+    h, w, src, dst, mask, _, n = _bucketed_layout(
+        seed=5, caps=(64, 64), live=(20, 30))
+    offs = (0, 24, 88)                        # 24 % 64 != 0
+    assert not tiles_align(offs) and EDGE_TILE == 64
+    a = np.asarray(gather_matmul_segment(h, w, src, dst, mask, offs, n))
+    b = np.asarray(pallas_gather_matmul_segment(
+        h, w, src, dst, mask, offs, n))
+    assert np.array_equal(a, b)
+
+
+def test_pallas_gms_rectangular_transform_and_grad_contract():
+    """[R, H, K] with K != H exercises the gather scratch's H width vs
+    the message tile's K width; and the serving-only contract holds —
+    differentiating through the Pallas kernel raises instead of silently
+    producing wrong gradients (training must stay on the XLA kernel)."""
+    import jax
+    h, w, src, dst, mask, offs, n = _bucketed_layout(
+        seed=6, caps=(64, 64), live=(33, 48), h=8, k=16)
+    assert w.shape[-2:] == (8, 16)
+    a = np.asarray(gather_matmul_segment(h, w, src, dst, mask, offs, n))
+    b = np.asarray(pallas_gather_matmul_segment(
+        h, w, src, dst, mask, offs, n))
+    assert np.array_equal(a, b)
+    with pytest.raises(Exception):
+        jax.grad(lambda hh: pallas_gather_matmul_segment(
+            hh, w, src, dst, mask, offs, n).sum())(h)
 
 
 def test_scatter_add_and_max():
