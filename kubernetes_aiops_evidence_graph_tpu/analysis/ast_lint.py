@@ -30,6 +30,13 @@ Rules (ids are what the waiver pragma names):
   modules must be declared in :data:`JIT_DECLARATIONS` with its exact
   static_argnames and donate_argnums (completeness: a new jitted kernel
   must register its signature — and its jaxpr entrypoint — to land).
+* ``tick-donation``   — a resident-state tick entrypoint (a jit site
+  named ``tick`` or ``*_tick`` under the hot dirs) that donates no
+  buffers: the tick applies per-dispatch deltas to device-resident
+  mirror state, so un-donated state means XLA reallocates the full
+  mirror every tick (and a pipelined executor holds depth+1 copies live
+  in HBM). The exact donated positions are pinned by
+  :data:`JIT_DECLARATIONS`; this rule catches the class.
 
 Waiver pragma: ``# graft-audit: allow[rule] reason`` on the offending
 line or the line above. Waived sites are counted and reported, never
@@ -87,10 +94,11 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
         ()),
     ("rca/gnn_streaming.py", "_gnn_tick"): (
         ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype"),
-        ()),
+        (2, 3, 4, 5, 6, 7)),
     ("rca/streaming.py", "_tick"): (
-        ("padded_incidents", "pair_width", "pk", "rk", "width"), ()),
-    ("rca/streaming.py", "tick"): ((), ()),
+        ("padded_incidents", "pair_width", "pk", "rk", "width"),
+        (0, 3, 4, 5)),
+    ("rca/streaming.py", "tick"): ((), (0, 3, 4, 5)),
     ("rca/tpu_backend.py", "_score_device"): (
         ("padded_incidents", "pair_width"), ()),
     ("rca/device_metrics.py", "_scan_stream"): (("k",), ()),
@@ -248,6 +256,7 @@ class _FileLint:
         if self.in_hot:
             self._host_sync()
             self._missing_static(traced)
+            self._tick_donation()
             if check_jit_declarations:
                 self._jit_declarations()
         return self.findings
@@ -414,8 +423,9 @@ class _FileLint:
                              "will be traced (retrace per value or "
                              "ConcretizationError)")
 
-    def _jit_declarations(self) -> None:
-        sites: list[tuple[str, set[str], tuple[int, ...], int]] = []
+    def _jit_sites(self) -> list[tuple[str, set, tuple, int]]:
+        """Every jit site in this module: decorated defs + call-form."""
+        sites: list[tuple[str, set, tuple, int]] = []
         for n in ast.walk(self.tree):
             if isinstance(n, ast.FunctionDef):
                 dec = _jit_decoration(n)
@@ -423,7 +433,25 @@ class _FileLint:
                     sites.append((n.name, dec[0], dec[1], n.lineno))
         for fname, (statics, donate, lineno) in self.call_form_jits.items():
             sites.append((fname, statics, donate, lineno))
-        for fname, statics, donate, lineno in sites:
+        return sites
+
+    def _tick_donation(self) -> None:
+        """Resident-state tick entrypoints must donate their mirror state
+        (graft-pipeline): a tick named ``tick``/``*_tick`` with an empty
+        donate_argnums reallocates the full resident set every dispatch."""
+        for fname, _statics, donate, lineno in self._jit_sites():
+            if fname != "tick" and not fname.endswith("_tick"):
+                continue
+            if not tuple(donate):
+                self.hit("tick-donation", lineno,
+                         f"tick entrypoint '{fname}' donates no buffers — "
+                         "the resident mirror state it updates must flow "
+                         "through donate_argnums or every tick reallocates "
+                         "it (exact positions are pinned in "
+                         "JIT_DECLARATIONS)")
+
+    def _jit_declarations(self) -> None:
+        for fname, statics, donate, lineno in self._jit_sites():
             declared = JIT_DECLARATIONS.get((self.rel, fname))
             if declared is None:
                 self.hit("jit-undeclared", lineno,

@@ -207,11 +207,11 @@ def _sharded_build(halo: str):
     return build
 
 
-def _rules_tick_build():
+def _rules_tick_build(pk: int = 64, rk: int = 4):
     np = _np()
     from ..graph.schema import DIM
     from ..rca.streaming import _tick
-    pn, pi, width, pair_width, pk, rk = 4096, 32, 128, 16, 64, 4
+    pn, pi, width, pair_width = 4096, 32, 128, 16
     ints = np.zeros(pk + 2 * rk + 2 * rk * width, np.int32)
     fn = partial(_tick, padded_incidents=pi, pair_width=pair_width,
                  pk=pk, rk=rk, width=width)
@@ -223,12 +223,21 @@ def _rules_tick_build():
     return fn, args
 
 
-def _gnn_tick_build():
+def _rules_tick_coalesced_build():
+    """The queue-full coalescing bound: a merged delta at the TOP of the
+    delta/row ladders (graft-pipeline). tick_async() never mints a shape
+    beyond these — a larger merge stalls for a pipeline slot instead —
+    so this entrypoint pins the worst tick the executor may dispatch."""
+    from ..rca.streaming import _DELTA_BUCKETS, _ROW_BUCKETS
+    return _rules_tick_build(pk=_DELTA_BUCKETS[-1], rk=_ROW_BUCKETS[-1])
+
+
+def _gnn_tick_build(pk: int = 64, ek: int = 256):
     np = _np()
     from ..graph.schema import DIM
     from ..rca.gnn_streaming import _gnn_tick
     offs = _rel_offsets()
-    pn, pi, pk, ek = 4096, 32, 64, 256
+    pn, pi = 4096, 32
     pe = int(offs[-1])
     ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
     # the mirror never promises slices_sorted (slot reuse under churn)
@@ -239,6 +248,15 @@ def _gnn_tick_build():
             np.zeros(pe, np.int32), np.zeros(pe, np.int32),
             np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
     return fn, args
+
+
+def _gnn_tick_coalesced_build():
+    """Worst coalesced GNN tick the pipelined executor may dispatch:
+    aux + edge deltas merged to the top of the _DELTA_BUCKETS ladder
+    (each pending edge packs two directed slot entries, so edge-heavy
+    queue-full merges land here first)."""
+    from ..rca.streaming import _DELTA_BUCKETS
+    return _gnn_tick_build(pk=_DELTA_BUCKETS[-1], ek=_DELTA_BUCKETS[-1])
 
 
 def _gms_build(compute_dtype=None):
@@ -408,6 +426,21 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
         cost=COST_DEFAULT),
     Entrypoint("streaming.rules_tick", _rules_tick_build, _TICK),
     Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
+    Entrypoint(
+        "streaming.rules_tick.coalesced", _rules_tick_coalesced_build,
+        _TICK,
+        notes="queue-full adaptive coalescing merges pending deltas up to "
+              "the top of the delta/row ladders (graft-pipeline); the "
+              "merged tick must hold the same invariants and cost "
+              "envelope as the steady-state tick — no silent FLOP/byte "
+              "growth hiding in the coalesced shape",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.gnn_tick.coalesced", _gnn_tick_coalesced_build, _TICK,
+        notes="worst coalesced GNN tick (aux+edge deltas at the ladder "
+              "top); explicit zero-collective CostSpec — the serving tick "
+              "may never go distributed implicitly",
+        cost=COST_DEFAULT),
     Entrypoint("ops.gather_matmul_segment", _gms_build(), _HOT),
     Entrypoint(
         "ops.gather_matmul_segment.bf16", _gms_build("bfloat16"),

@@ -101,6 +101,14 @@ class Settings:
     tracing_enabled: bool = True
 
     # --- TPU-native knobs (new in this framework) ---
+    # pipelined serving executor (rca/streaming.py): max ticks in flight
+    # (dispatched but unfetched). Depth 1 = the old serialized
+    # dispatch→fetch loop; depth 2 (default) overlaps host delta-packing
+    # of tick t+1 with device execution of tick t. When the queue is
+    # full, pending deltas coalesce into one larger tick (bounded by the
+    # _DELTA_BUCKETS retrace ladder) instead of blocking or queueing
+    # unboundedly. Results are bit-identical at every depth.
+    serve_pipeline_depth: int = 2
     mesh_dp: int = 1                               # data-parallel axis (incidents)
     mesh_graph: int = 1                            # graph-parallel axis (node shards)
     node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)

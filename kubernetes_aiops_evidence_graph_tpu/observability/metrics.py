@@ -185,3 +185,28 @@ WORKFLOW_STEPS = REGISTRY.counter(
     "aiops_workflow_steps_total",
     "Workflow step outcomes by status (completed|failed) — feeds the "
     "WorkflowFailures alert rule")
+
+# Serving-pipeline instrumentation (graft-pipeline, rca/streaming.py):
+# the double-buffered executor that overlaps host delta staging with
+# device ticks and defers device_get to the caller boundary.
+SERVE_PIPELINE_INFLIGHT = REGISTRY.gauge(
+    "aiops_serve_pipeline_inflight",
+    "Dispatched-but-unfetched ticks in the serving pipeline")
+SERVE_PIPELINE_STALL_SECONDS = REGISTRY.counter(
+    "aiops_serve_pipeline_stall_seconds_total",
+    "Time blocked waiting for a pipeline slot after the coalescing bound "
+    "(top of the delta ladder) was reached")
+SERVE_COALESCED_TICKS = REGISTRY.counter(
+    "aiops_serve_coalesced_ticks_total",
+    "Tick submissions whose deltas merged into a later, larger tick "
+    "because the pipeline was full (backpressure without blocking)")
+SERVE_COALESCED_TICK_SIZE = REGISTRY.gauge(
+    "aiops_serve_coalesced_tick_size",
+    "Pending delta entries carried by the most recent coalesced tick")
+SERVE_DEFERRED_FETCHES = REGISTRY.counter(
+    "aiops_serve_deferred_fetches_total",
+    "Tick results superseded and dropped without a device->host fetch "
+    "(the readback the deferred-fetch boundary avoided)")
+SERVE_FETCHED_BYTES = REGISTRY.counter(
+    "aiops_serve_fetched_bytes_total",
+    "Bytes actually moved device->host by serving fetches, by path label")

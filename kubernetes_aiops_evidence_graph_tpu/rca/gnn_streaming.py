@@ -65,12 +65,20 @@ _EdgeKey = tuple[str, str, int]   # (src_id, dst_id, kind) — store edge key
 
 
 @partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
-                                   "slices_sorted", "compute_dtype"))
+                                   "slices_sorted", "compute_dtype"),
+         donate_argnums=(2, 3, 4, 5, 6, 7))
 def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
               pk: int, ek: int, pi: int, rel_offsets=None,
               slices_sorted: bool = False, compute_dtype=None):
     """Apply the packed aux/edge deltas to the resident arrays, then run
-    the full forward. One int32 transfer carries every delta (the tunnel
+    the full forward. The resident mirror (kind/nmask + the four edge
+    arrays) is DONATED — the caller replaces its handles with the
+    returned buffers, so XLA applies the delta scatters in place instead
+    of reallocating the whole mirror per tick (`tick-donation` audit
+    rule). ``features`` is NOT donated: it is the base scorer's resident
+    buffer and must survive this tick for the next rules tick. Warm
+    paths pass stand-ins for the donated positions, never live handles.
+    One int32 transfer carries every delta (the tunnel
     charges per-transfer latency — see streaming._tick):
 
       [ f_idx pk | kind_v pk | nmask_v pk |
@@ -123,7 +131,8 @@ class GnnStreamingScorer(StreamingScorer):
     """
 
     def __init__(self, store: EvidenceGraphStore, settings=None,
-                 params: gnn.Params | None = None, mesh=None) -> None:
+                 params: gnn.Params | None = None, mesh=None,
+                 now_s: float | None = None) -> None:
         if params is None:
             from .gnn_backend import GnnRcaBackend
             # resolve the checkpoint from the settings THIS scorer was
@@ -141,7 +150,7 @@ class GnnStreamingScorer(StreamingScorer):
         cfg = settings or get_settings()
         self._use_bucketed = bool(getattr(cfg, "gnn_bucketed", True))
         self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
-        super().__init__(store, settings, mesh=mesh)
+        super().__init__(store, settings, mesh=mesh, now_s=now_s)
 
     def _tick_statics(self, rel_offsets=None, slices_sorted=None) -> dict:
         """Static kwargs for _gnn_tick under the current mode. A fresh
@@ -375,6 +384,16 @@ class GnnStreamingScorer(StreamingScorer):
         ]).astype(np.int32, copy=False)
         return ints, pk, ek
 
+    def _tick_handles(self, out: tuple) -> tuple:
+        """The pipeline queue tracks the GNN tick's outputs: in gnn mode
+        the base rules handles are never fetched, so the GNN probs are
+        both the completion signal and the deferred-fetch surface."""
+        return self._last_gnn
+
+    def _pending_delta_count(self) -> int:
+        # each pending edge entry is one directed slot in the packed delta
+        return super()._pending_delta_count() + len(self._pending_edges)
+
     def dispatch(self) -> tuple:
         """Base fused tick (shared feature deltas + rules score), then the
         GNN tick on the UPDATED features. Returns the base device handles
@@ -394,17 +413,27 @@ class GnnStreamingScorer(StreamingScorer):
         return out
 
     def rescore(self) -> dict:
-        """GnnRcaBackend.score_snapshot-shaped raw dict for live incidents
-        (one host fetch)."""
+        """GnnRcaBackend.score_snapshot-shaped raw dict for live incidents.
+        Same caller-boundary contract as the base rescore: one fresh tick
+        reflecting every pending delta, older in-flight results dropped
+        unfetched, exactly one device_get, dispatch/fetch timings split."""
         import time
+        from ..observability import metrics as obs_metrics
         stats = {"feature_updates": len(self._pending_feat),
                  "structural_refresh": bool(self._dirty_rows),
-                 "rebuilds": self.rebuilds}
+                 "rebuilds": self.rebuilds,
+                 "coalesced_ticks": self.coalesced_ticks,
+                 "deferred_fetches": self.deferred_fetches}
         t1 = time.perf_counter()
         self.dispatch()
+        self._supersede_inflight()
+        dispatch_s = time.perf_counter() - t1
+        t2 = time.perf_counter()
         probs = np.asarray(jax.device_get(self._last_gnn[1]))
-        device_s = time.perf_counter() - t1
+        fetch_s = time.perf_counter() - t2
         self.fetches += 1
+        obs_metrics.SERVE_FETCHED_BYTES.inc(
+            float(probs.nbytes), path="gnn_rescore")
         ids, rows = self.live_incidents()
         p = probs[rows]
         pred = p.argmax(axis=-1)
@@ -414,7 +443,9 @@ class GnnStreamingScorer(StreamingScorer):
             "top_rule_index": pred,
             "any_match": pred != NUM_RULES,
             "top_confidence": p.max(axis=-1),
-            "device_seconds": device_s,
+            "dispatch_seconds": dispatch_s,
+            "fetch_seconds": fetch_s,
+            "device_seconds": dispatch_s + fetch_s,
             **stats,
         }
 
@@ -429,17 +460,20 @@ class GnnStreamingScorer(StreamingScorer):
         (code-review r5). Both sorted variants are warmed: fresh-mirror /
         post-rebuild ticks claim slices_sorted=True, the first in-place
         churn flips to False — neither transition may pay a mid-serve
-        compile. All-dropped deltas: read-only, resident handles kept.
-        The handles are captured under serve_lock — a concurrent rebuild
-        swapping them one attribute at a time must not hand jit a mixed
-        old/new shape set (same reason as base warm(), streaming.py)."""
+        compile. All-dropped deltas, and the DONATED mirror positions get
+        fresh zero stand-ins per call (the tick donates kind/nmask + the
+        four edge arrays; the live handles must never flow in here —
+        donation would invalidate the serving state). params and features
+        are read-only and stay live. Shapes are captured under serve_lock
+        — a concurrent rebuild swapping them one attribute at a time must
+        not hand jit a mixed old/new shape set (same reason as base
+        warm(), streaming.py)."""
         with self.serve_lock:
             pi = self.snapshot.padded_incidents
             pn = self.snapshot.padded_nodes
             pe = int(self._esrc_dev.shape[0])
-            handles = (self._params, self._features_dev, self._kind_dev,
-                       self._nmask_dev, self._esrc_dev, self._edst_dev,
-                       self._erel_dev, self._emask_dev)
+            params = self._params
+            features_dev = self._features_dev
             variants = [self._tick_statics(slices_sorted=ss) for ss in
                         ((True, False) if self._use_bucketed else (False,))]
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
@@ -457,7 +491,14 @@ class GnnStreamingScorer(StreamingScorer):
                         np.zeros(ek, np.int32),
                         inc_n, inc_m,
                     ]).astype(np.int32, copy=False)
-                    _gnn_tick(*handles, jnp.asarray(ints), pk=pk, ek=ek,
+                    _gnn_tick(params, features_dev,
+                              jnp.zeros(pn, jnp.int32),
+                              jnp.zeros(pn, jnp.float32),
+                              jnp.zeros(pe, jnp.int32),
+                              jnp.zeros(pe, jnp.int32),
+                              jnp.full((pe,), -1, jnp.int32),
+                              jnp.zeros(pe, jnp.float32),
+                              jnp.asarray(ints), pk=pk, ek=ek,
                               pi=pi, **statics)
 
     def warm_growth(self) -> None:

@@ -23,6 +23,7 @@ snapshot rebuild — counted in stats so benches can prove it stays rare.
 """
 from __future__ import annotations
 
+import collections
 import functools
 import threading
 import time
@@ -37,6 +38,7 @@ import jax.numpy as jnp
 
 from ..config import Settings, get_settings
 from ..observability import get_logger
+from ..observability import metrics as obs_metrics
 from ..graph.schema import EntityKind, RelationKind
 from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_features
 from ..graph.store import EvidenceGraphStore
@@ -56,16 +58,22 @@ class NeedsRebuild(Exception):
 
 
 @partial(jax.jit, static_argnames=("padded_incidents", "pair_width",
-                                   "pk", "rk", "width"))
+                                   "pk", "rk", "width"),
+         donate_argnums=(0, 3, 4, 5))
 def _tick(features, ints, f_rows, ev_idx, ev_cnt, ev_pair,
           chain, padded_incidents: int, pair_width: int,
           pk: int, rk: int, width: int):
     """One fused device call per tick: scatter the padded feature delta and
     the padded evidence-row delta into the resident state, then score.
     Out-of-range indices (the padding of each delta) drop out. The caller
-    replaces its state handles with the returned buffers. No buffer
-    donation: the axon-tunneled backend measurably slows with donated
-    inputs, and the on-device copies are ~µs.
+    replaces its state handles with the returned buffers. The resident
+    state (features + the three evidence tables) is DONATED: the caller
+    never reads the pre-tick buffers again, so XLA aliases the delta
+    scatters in place instead of reallocating the full mirror every tick
+    — at pipeline depth > 1 the un-donated variant holds depth+1 copies
+    of the resident set live in HBM. Enforced by the `tick-donation`
+    audit rule (analysis/ast_lint.py); warm paths must therefore pass
+    stand-in buffers, never the live handles.
 
     All integer delta arrays arrive PACKED in one flat int32 buffer
     (f_idx | r_idx | r_cnt | r_ev | r_pair): the dev tunnel charges
@@ -162,7 +170,9 @@ def _graph_tick(mesh, nodes_per_shard: int, rows_per_shard: int,
         out_specs=(g, d, d, d) + (d,) * 7,
         check_vma=False,
     )
-    return jax.jit(tick)
+    # same donation contract as the single-device _tick: the resident
+    # state flows through, so the sharded tick must not reallocate it
+    return jax.jit(tick, donate_argnums=(0, 3, 4, 5))
 
 
 # Bound interpreter exit on ANY path, including scripts that use
@@ -198,9 +208,16 @@ class StreamingScorer:
 
     def __init__(self, store: EvidenceGraphStore,
                  settings: Settings | None = None,
-                 mesh: "jax.sharding.Mesh | None" = None) -> None:
+                 mesh: "jax.sharding.Mesh | None" = None,
+                 now_s: float | None = None) -> None:
         self.settings = settings or get_settings()
         self.store = store
+        # deterministic replay clock: recency features (e.g. deploy age)
+        # extract against THIS epoch instead of the wall clock when set.
+        # Serving leaves it None; replay harnesses (the pipeline depth
+        # sweep, the depth-parity tests) pin it so two replays of one
+        # seeded script produce bit-identical feature rows.
+        self.now_s = now_s
         # optional device mesh with a "dp" axis: the resident incident
         # tables shard over it (features replicated — every shard gathers
         # arbitrary global node ids), so one resident scorer serves from
@@ -226,6 +243,21 @@ class StreamingScorer:
         # serializes sync()+dispatch() for multi-threaded serving (workflow
         # steps run on executor threads); single-threaded benches skip it
         self.serve_lock = threading.Lock()
+        # pipelined serving executor (graft-pipeline): a bounded queue of
+        # dispatched-but-unfetched tick results. tick_async() overlaps the
+        # host's delta-packing of tick t+1 with device execution of tick t
+        # and never blocks while a slot is free; a full queue coalesces
+        # pending deltas into one larger tick (bounded by the top of the
+        # _DELTA_BUCKETS ladder) instead of queueing unboundedly. Results
+        # are only ever fetched at the caller boundary — rescore()/serve()
+        # fetch the NEWEST tick once and drop superseded results unfetched.
+        self.pipeline_depth = max(1, int(getattr(
+            self.settings, "serve_pipeline_depth", 2)))
+        self._inflight: collections.deque = collections.deque()
+        self._coalesce_bound = _DELTA_BUCKETS[-1]
+        self.coalesced_ticks = 0
+        self.deferred_fetches = 0
+        self.stall_seconds = 0.0
         # coalesced-serving state (see serve()): one device pass satisfies
         # every caller whose store writes preceded that pass's sync
         self._serve_cv = threading.Condition()
@@ -242,12 +274,20 @@ class StreamingScorer:
         state. Called at construction and on bucket-overflow rebuilds.
         Buckets are picked with 1/3 growth slack so structural churn lands
         in free padded rows instead of forcing mid-stream rebuilds."""
+        # a rebuild supersedes every in-flight tick result (and their
+        # buffers carry the OLD shapes): drop them unfetched
+        stale = getattr(self, "_inflight", None)
+        if stale:
+            self.deferred_fetches += len(stale)
+            obs_metrics.SERVE_DEFERRED_FETCHES.inc(float(len(stale)))
+            stale.clear()
         # capture the journal cursor BEFORE tensorizing: mutations landing
         # in between are both in the snapshot and replayed by the next
         # sync(), and every mirror op is an idempotent MERGE, so replays
         # are safe while missed records would not be
         self._synced_seq = self.store.journal_seq
-        snap = build_snapshot(self.store, self.settings, slack=1 / 3)
+        snap = build_snapshot(self.store, self.settings, slack=1 / 3,
+                              now_s=self.now_s)
         self.snapshot: GraphSnapshot = snap
         pn, pi = snap.padded_nodes, snap.padded_incidents
 
@@ -565,7 +605,7 @@ class StreamingScorer:
         self.snapshot.node_mask[row] = 1.0
         if node is not None:
             self.snapshot.node_kind[row] = int(node.kind)
-            feats = extract_node_features(node)
+            feats = extract_node_features(node, now_s=self.now_s)
         else:
             feats = np.zeros(self.snapshot.features.shape[1], np.float32)
         self.snapshot.features[row] = feats
@@ -830,7 +870,7 @@ class StreamingScorer:
             node = self.store._nodes.get(nid)
             if idx is None or node is None:
                 continue
-            row = extract_node_features(node)
+            row = extract_node_features(node, now_s=self.now_s)
             self.snapshot.features[idx] = row  # keep host copy coherent
             self._pending_feat[idx] = row
             n += 1
@@ -883,35 +923,40 @@ class StreamingScorer:
             return
         # capture a CONSISTENT view under serve_lock (a concurrent rebuild
         # swapping shapes mid-capture hands jit mismatched operand shapes);
-        # the expensive compiles then run outside the lock on the captured
-        # handles — read-only, so staleness is harmless
+        # the expensive compiles then run outside the lock
         with self.serve_lock:
             pn = self.snapshot.padded_nodes
             pi = self.snapshot.padded_incidents
             dim = self.snapshot.features.shape[1]
             cur_w = self.pair_width
             cur_width = self.width
-            features_dev = self._features_dev
-            cur_tables = (self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev)
-            ev_cnt_dev = self._ev_cnt_dev
             chain0 = self._chain0
+            sharded = self._sharded(pi)
+            shardings = self._shardings(pn, pi) if sharded else None
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
         widths = [cur_width]
         if include_next_width:
             widths.append(bucket_for(cur_width + 1, _WIDTH_BUCKETS))
+
+        def standins(width: int, pw: int):
+            # the tick DONATES (features, ev_idx, ev_cnt, ev_pair): handing
+            # it the live resident handles would invalidate the serving
+            # state, so every warm call consumes a FRESH zero stand-in set
+            # (a donated buffer is dead after one execution) — placed like
+            # the live state, since executables key on input shardings
+            feats = jnp.zeros((pn, dim), jnp.float32)
+            tables = (jnp.zeros((pi, width), jnp.int32),
+                      jnp.zeros((pi,), jnp.int32),
+                      jnp.full((pi, width), pw, jnp.int32))
+            if sharded:
+                rep, row1, row2 = shardings
+                feats = jax.device_put(feats, rep)
+                tables = (jax.device_put(tables[0], row2),
+                          jax.device_put(tables[1], row1),
+                          jax.device_put(tables[2], row2))
+            return feats, tables
+
         for width in widths:
-            if width == cur_width:
-                tables = cur_tables
-            else:   # stand-ins at the next width; result discarded
-                tables = (jnp.zeros((pi, width), jnp.int32),
-                          ev_cnt_dev,
-                          jnp.full((pi, width), cur_w, jnp.int32))
-                if self._sharded(pi):
-                    # compiled executables key on input shardings: the
-                    # stand-ins must match the live tables' placement
-                    _, _, row2 = self._shardings(pn, pi)
-                    tables = (jax.device_put(tables[0], row2), tables[1],
-                              jax.device_put(tables[2], row2))
             for pk in delta_sizes:
                 f_idx = np.full(pk, pn, dtype=np.int32)   # all-dropped deltas
                 f_rows = np.zeros((pk, dim), np.float32)
@@ -924,13 +969,15 @@ class StreamingScorer:
                             return
                         r_pair = np.full((rk, width), pw, np.int32)
                         ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
+                        feats, tables = standins(width, pw)
                         self._tick_fn(pn, pi, width, pw, pk=pk, rk=rk)(
-                            features_dev, jnp.asarray(ints),
+                            feats, jnp.asarray(ints),
                             jnp.asarray(f_rows), *tables, chain0)
-        # READ-ONLY: results discarded, resident handles untouched (no-op
-        # deltas leave the state bit-identical, and not swapping the
-        # handles is what makes warm() safe to run from a background
-        # thread concurrently with serving dispatches)
+        # READ-ONLY with respect to serving: results discarded and the
+        # live resident handles are never passed to the donating tick
+        # (stand-ins compile the exact executables the serving shapes
+        # hit), which is what keeps warm() safe to run from a background
+        # thread concurrently with serving dispatches
 
     def _rebuild_widths(self) -> tuple[int, int]:
         """(width, pair_width) a rebuild would derive from CURRENT host
@@ -1088,6 +1135,103 @@ class StreamingScorer:
          self._pair_dev) = out[:4]
         return out[4:]
 
+    # -- pipelined executor (graft-pipeline) -------------------------------
+    #
+    # dispatch() is async already (jax enqueues and returns handles); what
+    # serialized the old loop was the blocking jax.device_get after EVERY
+    # tick. The executor splits the two: tick_async() submits ticks into a
+    # bounded in-flight queue (depth = settings.serve_pipeline_depth) so
+    # the host packs tick t+1 while the device runs tick t, and the fetch
+    # happens once at the caller boundary (rescore()/serve()), dropping
+    # superseded results without a readback. Backpressure is adaptive
+    # coalescing: a full queue leaves the deltas pending, where they merge
+    # into one larger tick on the existing _DELTA_BUCKETS ladder — the
+    # queue never grows past depth and no delta is ever dropped. Only when
+    # the merged delta would overflow the ladder's top bucket (which would
+    # mint an unplanned compile) does the executor block for a slot, and
+    # that wait is counted as stall time.
+
+    def _tick_handles(self, out: tuple) -> tuple:
+        """The device handles of one dispatched tick: what the in-flight
+        queue holds, whose readiness marks the tick complete, and whose
+        fetch the caller boundary may defer. Subclasses override to point
+        at their own result surface (GnnStreamingScorer -> the GNN tick's
+        outputs)."""
+        return out
+
+    def _tick_ready(self, handles: tuple) -> bool:
+        h = handles[-1]
+        if not hasattr(h, "is_ready"):
+            return True
+        try:
+            return bool(h.is_ready())
+        except RuntimeError:    # buffer already consumed: long complete
+            return True
+
+    def _retire_ready(self) -> None:
+        """Pop completed ticks off the head of the in-flight queue. Their
+        results are superseded without ever being fetched — exactly the
+        per-tick readback the deferred-fetch boundary exists to avoid."""
+        n0 = len(self._inflight)
+        while self._inflight and self._tick_ready(self._inflight[0]):
+            self._inflight.popleft()
+            self.deferred_fetches += 1
+        if n0 != len(self._inflight):
+            obs_metrics.SERVE_DEFERRED_FETCHES.inc(
+                float(n0 - len(self._inflight)))
+        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(float(len(self._inflight)))
+
+    def _pending_delta_count(self) -> int:
+        """Host-side delta entries a coalesced tick would carry (bounds
+        the merge against the delta ladder)."""
+        return len(self._pending_feat) + len(self._dirty_rows)
+
+    def tick_async(self) -> dict:
+        """Pipelined tick submission for streaming drivers: flush pending
+        deltas into one tick and enqueue it WITHOUT fetching, as long as a
+        pipeline slot is free. On a full queue the deltas stay pending and
+        merge into the next submitted tick (adaptive coalescing) instead
+        of blocking — unless the merged delta would overflow the top
+        _DELTA_BUCKETS bucket, in which case the executor stalls for the
+        oldest tick (counted in ``stall_seconds``). Returns a small stats
+        dict; results are fetched later via rescore()/serve()."""
+        with self.serve_lock:
+            self._retire_ready()
+            if len(self._inflight) >= self.pipeline_depth:
+                pending = self._pending_delta_count()
+                if pending < self._coalesce_bound:
+                    self.coalesced_ticks += 1
+                    obs_metrics.SERVE_COALESCED_TICKS.inc()
+                    obs_metrics.SERVE_COALESCED_TICK_SIZE.set(float(pending))
+                    return {"dispatched": False, "coalesced": True,
+                            "inflight": len(self._inflight),
+                            "pending": pending}
+                t0 = time.perf_counter()
+                oldest = self._inflight.popleft()
+                jax.block_until_ready(oldest[-1])
+                stall = time.perf_counter() - t0
+                self.stall_seconds += stall
+                self.deferred_fetches += 1
+                obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(stall)
+                obs_metrics.SERVE_DEFERRED_FETCHES.inc()
+            out = self.dispatch()
+            self._inflight.append(self._tick_handles(out))
+            obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
+                float(len(self._inflight)))
+            return {"dispatched": True, "coalesced": False,
+                    "inflight": len(self._inflight), "pending": 0}
+
+    def _supersede_inflight(self) -> None:
+        """A fresh caller-boundary tick makes every queued result stale:
+        drop them all, unfetched (serve() fetches once per generation,
+        not once per tick)."""
+        if self._inflight:
+            self.deferred_fetches += len(self._inflight)
+            obs_metrics.SERVE_DEFERRED_FETCHES.inc(
+                float(len(self._inflight)))
+            self._inflight.clear()
+        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(0.0)
+
     def serve(self) -> dict:
         """Coalesced sync + rescore for concurrent serving callers.
 
@@ -1141,15 +1285,31 @@ class StreamingScorer:
         return [p[1] for p in pairs], [p[0] for p in pairs]
 
     def rescore(self) -> dict:
+        """Caller-boundary tick + fetch. The dispatched tick reflects every
+        pending delta (including ones coalesced by a full pipeline), so its
+        result supersedes the whole in-flight queue — older results are
+        dropped without a readback and exactly ONE device_get runs here.
+        ``dispatch_seconds`` is host packing + enqueue (the part pipelining
+        overlaps with device execution); ``fetch_seconds`` is the blocking
+        device wait + device->host readback; ``device_seconds`` keeps the
+        old conflated sum for back-compat consumers."""
         stats = {"feature_updates": len(self._pending_feat),
                  "structural_refresh": bool(self._dirty_rows),
-                 "rebuilds": self.rebuilds}
+                 "rebuilds": self.rebuilds,
+                 "coalesced_ticks": self.coalesced_ticks,
+                 "deferred_fetches": self.deferred_fetches}
         t1 = time.perf_counter()
         out = self.dispatch()
+        self._supersede_inflight()
+        dispatch_s = time.perf_counter() - t1
+        t2 = time.perf_counter()
+        fetched = jax.device_get(out)
+        fetch_s = time.perf_counter() - t2
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
-            jax.device_get(out))
-        device_s = time.perf_counter() - t1
+            fetched)
         self.fetches += 1
+        obs_metrics.SERVE_FETCHED_BYTES.inc(
+            float(sum(a.nbytes for a in fetched)), path="rules_rescore")
         ids, rows = self.live_incidents()
         return {
             "incident_ids": tuple(ids),
@@ -1160,6 +1320,8 @@ class StreamingScorer:
             "any_match": any_match[rows],
             "top_confidence": top_conf[rows],
             "top_score": top_score[rows],
-            "device_seconds": device_s,
+            "dispatch_seconds": dispatch_s,
+            "fetch_seconds": fetch_s,
+            "device_seconds": dispatch_s + fetch_s,
             **stats,
         }

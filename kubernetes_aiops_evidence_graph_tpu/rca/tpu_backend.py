@@ -428,33 +428,58 @@ class TpuRcaBackend:
         _, args, _ = self._load(snapshot)
         return args
 
-    def score_snapshot(self, snapshot: GraphSnapshot) -> dict:
+    # result-field groups for the fetch modes of score_snapshot, in the
+    # device-output order of _score_device. "top" is the narrowed serving
+    # fetch: per-incident verdict fields only — the [Pi, C]/[Pi, R]
+    # conditions/matched/scores tables dominate the readback bytes and
+    # serving callers discard them, so they are never moved off-device.
+    _FETCH_FIELDS = {
+        "full": ("conditions", "matched", "scores", "top_rule_index",
+                 "any_match", "top_confidence", "top_score"),
+        "top": ("top_rule_index", "any_match", "top_confidence",
+                "top_score"),
+    }
+
+    def score_snapshot(self, snapshot: GraphSnapshot,
+                       fields: str = "full") -> dict:
         """Score every incident in the snapshot in one device pass.
 
         Returns a dict of host numpy arrays keyed by incident order
-        (snapshot.incident_ids); use :meth:`results` for model objects.
+        (snapshot.incident_ids); use :meth:`results` for model objects
+        (which needs the default ``fields="full"``). ``fields="top"``
+        fetches only the top-k verdict fields — the per-condition /
+        per-rule tables stay on device and their readback is never paid.
+        Every fetch increments the ``aiops_serve_fetched_bytes_total``
+        counter (path="score_snapshot") with the bytes actually moved.
         """
         _, _, prep_s = self._load(snapshot)  # dispatch() below hits the cache
 
+        all_fields = self._FETCH_FIELDS["full"]
+        keys = self._FETCH_FIELDS[fields]    # KeyError = unknown fetch mode
         t1 = time.perf_counter()
         out = self.dispatch(snapshot)
-        conds, matched, scores, top_idx, any_match, top_conf, top_score = (
-            jax.device_get(out))  # one batched readback
-        device_s = time.perf_counter() - t1
+        dispatch_s = time.perf_counter() - t1
+        t2 = time.perf_counter()
+        fetched = jax.device_get(
+            tuple(out[all_fields.index(k)] for k in keys))  # one readback
+        fetch_s = time.perf_counter() - t2
+
+        from ..observability import metrics as obs_metrics
+        obs_metrics.SERVE_FETCHED_BYTES.inc(
+            float(sum(a.nbytes for a in fetched)), path="score_snapshot")
 
         n = snapshot.num_incidents
-        return {
+        res = {
             "incident_ids": snapshot.incident_ids,
-            "conditions": conds[:n],
-            "matched": matched[:n],
-            "scores": scores[:n],
-            "top_rule_index": top_idx[:n],
-            "any_match": any_match[:n],
-            "top_confidence": top_conf[:n],
-            "top_score": top_score[:n],
             "prep_seconds": prep_s,
-            "device_seconds": device_s,
+            "dispatch_seconds": dispatch_s,
+            "fetch_seconds": fetch_s,
+            "device_seconds": dispatch_s + fetch_s,
+            "fetched_fields": fields,
         }
+        for k, a in zip(keys, fetched):
+            res[k] = a[:n]
+        return res
 
     def results(self, snapshot: GraphSnapshot | None = None,
                 raw: dict | None = None) -> list[RCAResult]:

@@ -1,0 +1,12 @@
+"""Seeded violation: a resident-state tick whose jit signature donates
+nothing — every dispatch would reallocate the full device-resident
+mirror instead of aliasing the delta scatter in place (rule
+``tick-donation``)."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("pk",))
+def _tick(state, delta, rows, pk: int):
+    return state.at[delta[:pk]].set(rows, mode="drop")

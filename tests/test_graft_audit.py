@@ -41,6 +41,7 @@ AST_EXPECTED = {
     "rca/host_sync.py": "host-sync",
     "rca/missing_static.py": "missing-static",
     "rca/np_traced.py": "np-in-traced",
+    "rca/tick_undonated.py": "tick-donation",
     "workflow/broad_except.py": "broad-except",
     "observability/wall_clock.py": "wall-clock",
 }
@@ -83,6 +84,22 @@ def test_pallas_kernel_bodies_are_traced_and_wrappers_declared():
     assert "pallas_gather_matmul_segment" in TRACED_EXTRA
     assert ("rca/gnn.py", "forward") in JIT_DECLARATIONS
     assert "pallas" in JIT_DECLARATIONS[("rca/gnn.py", "forward")][0]
+
+
+def test_shipped_ticks_declare_their_mirror_state_donation():
+    """graft-pipeline pin: the seeded un-donated tick fixture trips
+    exactly `tick-donation` (AST_EXPECTED above drives it through the
+    fixture tree + CLI); here the SHIPPED resident-state ticks must keep
+    their mirror-state donation declared — dropping a donate_argnums
+    regresses to per-tick reallocation of the full resident set."""
+    assert JIT_DECLARATIONS[("rca/streaming.py", "_tick")][1] == (0, 3, 4, 5)
+    assert JIT_DECLARATIONS[("rca/streaming.py", "tick")][1] == (0, 3, 4, 5)
+    assert JIT_DECLARATIONS[("rca/gnn_streaming.py", "_gnn_tick")][1] == \
+        (2, 3, 4, 5, 6, 7)
+    # the registry audits the coalesced tick shapes too (queue-full merges)
+    names = {e.name for e in ENTRYPOINTS}
+    assert {"streaming.rules_tick.coalesced",
+            "streaming.gnn_tick.coalesced"} <= names
 
 
 def test_ast_clean_tree_has_no_violations_and_counts_the_waiver():
