@@ -37,6 +37,15 @@ Rules (ids are what the waiver pragma names):
   mirror every tick (and a pipelined executor holds depth+1 copies live
   in HBM). The exact donated positions are pinned by
   :data:`JIT_DECLARATIONS`; this rule catches the class.
+* ``recovery-no-broad-except`` — a broad except inside a RECOVERY
+  function (name matching recover/degrad/fallback/quarantine/watchdog/
+  escalat under the hot dirs) that neither re-raises nor escalates (a
+  call whose name contains ``escalat``): a degradation path that
+  swallows errors turns a non-transient fault into silent wrong-tier
+  serving — the one place broad-except may NOT be waived into silence
+  (graft-shield). In recovery context this rule replaces the generic
+  ``broad-except``; handlers that escalate are the sanctioned pattern
+  and produce no finding.
 
 Waiver pragma: ``# graft-audit: allow[rule] reason`` on the offending
 line or the line above. Waived sites are counted and reported, never
@@ -93,8 +102,14 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
          "pallas"),
         ()),
     ("rca/gnn_streaming.py", "_gnn_tick"): (
-        ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype"),
+        ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype",
+         "pallas"),
         (2, 3, 4, 5, 6, 7)),
+    # graft-shield snapshot kernels: pack/unpack the resident state into
+    # ONE int32 transfer (no donation — the resident buffers must survive
+    # the snapshot; registered jaxpr entrypoints with zero-collective cost)
+    ("rca/shield.py", "_snapshot_pack"): ((), ()),
+    ("rca/shield.py", "_snapshot_unpack"): (("layout",), ()),
     ("rca/streaming.py", "_tick"): (
         ("padded_incidents", "pair_width", "pk", "rk", "width"),
         (0, 3, 4, 5)),
@@ -117,6 +132,11 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
 
 _WAIVER_RE = re.compile(
     r"#\s*graft-audit:\s*allow\[([a-zA-Z0-9_,\- ]+)\]\s*(.*)")
+
+# functions whose broad excepts fall under the stricter
+# recovery-no-broad-except contract (graft-shield)
+_RECOVERY_FN_RE = re.compile(
+    r"recover|degrad|fallback|quarantine|watchdog|escalat")
 
 
 def _dotted(node) -> str:
@@ -262,19 +282,45 @@ class _FileLint:
         return self.findings
 
     def _broad_except(self) -> None:
-        for n in ast.walk(self.tree):
-            if not isinstance(n, ast.ExceptHandler):
-                continue
-            t = n.type
-            broad = t is None or (isinstance(t, ast.Name)
-                                  and t.id in ("Exception", "BaseException"))
-            if not broad:
-                continue
-            if any(isinstance(b, ast.Raise) for b in ast.walk(n)):
-                continue   # catch-and-rethrow is instrumentation, not swallowing
-            self.hit("broad-except", n.lineno,
-                     "broad except swallows all errors; narrow the catch "
-                     "or waive with the isolation reason")
+        self._visit_excepts(self.tree, "")
+
+    def _visit_excepts(self, node, fname: str) -> None:
+        """Walk handlers tracking the innermost enclosing function name —
+        recovery-named functions get the stricter rule."""
+        for child in ast.iter_child_nodes(node):
+            nf = child.name if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fname
+            if isinstance(child, ast.ExceptHandler):
+                self._check_except(child, fname)
+            self._visit_excepts(child, nf)
+
+    def _check_except(self, n: ast.ExceptHandler, fname: str) -> None:
+        t = n.type
+        broad = t is None or (isinstance(t, ast.Name)
+                              and t.id in ("Exception", "BaseException"))
+        if not broad:
+            return
+        reraises = any(isinstance(b, ast.Raise) for b in ast.walk(n))
+        if self.in_hot and _RECOVERY_FN_RE.search(fname or ""):
+            # recovery context (graft-shield): swallowing is never an
+            # isolation boundary here — the handler must re-raise or
+            # escalate to the next degradation tier
+            escalates = any(
+                isinstance(b, ast.Call)
+                and "escalat" in _call_name(b).rsplit(".", 1)[-1]
+                for b in ast.walk(n))
+            if not (reraises or escalates):
+                self.hit("recovery-no-broad-except", n.lineno,
+                         f"broad except in recovery function '{fname}' "
+                         "neither re-raises nor escalates: a degradation "
+                         "path that swallows turns non-transient faults "
+                         "into silent wrong-tier serving")
+            return
+        if reraises:
+            return   # catch-and-rethrow is instrumentation, not swallowing
+        self.hit("broad-except", n.lineno,
+                 "broad except swallows all errors; narrow the catch "
+                 "or waive with the isolation reason")
 
     def _wall_clock(self) -> None:
         for n in ast.walk(self.tree):

@@ -337,6 +337,43 @@ def _propagate_build():
     return fn, args
 
 
+def _shield_shapes():
+    """Canonical resident-state shapes for the graft-shield snapshot
+    kernels: the rules scorer's resident set at the audit's canonical
+    node/incident buckets (features + the three evidence tables)."""
+    from ..graph.schema import DIM
+    width, pair_width = 128, 16
+    return (((N_NODES, DIM), "float32"),
+            ((N_INC, width), "int32"),
+            ((N_INC,), "int32"),
+            ((N_INC, width), "int32")), pair_width
+
+
+def _snapshot_pack_build():
+    np = _np()
+    from ..rca.shield import _snapshot_pack
+    layout, pw = _shield_shapes()
+    args = tuple(
+        np.zeros(shp, np.float32) if dt == "float32"
+        else np.full(shp, pw, np.int32)
+        for shp, dt in layout)
+    return _snapshot_pack, args
+
+
+def _snapshot_unpack_build():
+    np = _np()
+    from ..rca.shield import _snapshot_unpack
+    layout, _pw = _shield_shapes()
+    total = 0
+    for shp, _dt in layout:
+        n = 1
+        for d in shp:
+            n *= d
+        total += n
+    fn = partial(_snapshot_unpack, layout=layout)
+    return fn, (np.zeros(total, np.int32),)
+
+
 def _score_device_build():
     np = _np()
     from ..graph.schema import DIM
@@ -480,4 +517,20 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
         InvariantSpec(max_intermediate_bytes=HOT_BUDGET),
         notes="dense evidence fold — no per-edge scatter at all; the "
               "static-index condition writes lower to 1-D set-scatters"),
+    Entrypoint(
+        "shield.snapshot_pack", _snapshot_pack_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="graft-shield snapshot fetch: bitcast+concat the resident "
+              "state into ONE int32 buffer (one device->host transfer "
+              "per snapshot); recovery is pinned by the audit, not "
+              "trusted — explicit zero-collective CostSpec",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "shield.snapshot_unpack", _snapshot_unpack_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="graft-shield restore: slice+bitcast the packed snapshot "
+              "back into the resident buffers; zero collectives",
+        cost=COST_DEFAULT),
 )

@@ -109,6 +109,34 @@ class Settings:
     # _DELTA_BUCKETS retrace ladder) instead of blocking or queueing
     # unboundedly. Results are bit-identical at every depth.
     serve_pipeline_depth: int = 2
+    # graft-shield (rca/shield.py): crash-consistent recovery + graceful
+    # degradation over the donated serving state. When enabled, the
+    # workflow worker wraps the resident scorer in a ShieldedScorer: every
+    # applied delta batch is write-ahead journaled (fsync, O(delta)) and
+    # the resident state snapshots every `shield_snapshot_every_ticks`
+    # generation boundaries, so any single failure recovers via
+    # snapshot + journal-suffix replay — bit-identical and strictly
+    # cheaper than a full rebuild.
+    shield_enabled: bool = False
+    shield_dir: str = ""                       # "" -> .kaeg_shield/<pid>
+    # snapshot cadence: each snapshot is O(resident state) (one packed
+    # device fetch + host-state pickle), so it amortizes over the cadence;
+    # recovery replays at most this many ticks of journal suffix. At the
+    # serving target (~10 ticks/s) 512 ≈ one snapshot per minute.
+    shield_snapshot_every_ticks: int = 512
+    # WAL group commit: every delta batch is written+flushed before it is
+    # applied; the fsync may be deferred up to this many batches (1 =
+    # strict). Only whole-host crashes can lose the unsynced tail — the
+    # donated-state fault model keeps the host (and the page cache) alive.
+    shield_wal_fsync_every_ticks: int = 8
+    # watchdog: a tick exceeding this wall time counts a trip and degrades
+    # the pipeline to synchronous depth 1 (XLA dispatches cannot be
+    # cancelled host-side; the watchdog bounds *recurrence*, not the tick)
+    shield_tick_timeout_s: float = 30.0
+    # bounded retry for transient faults: exponential backoff with
+    # deterministic seeded jitter (workflow/engine.RetryPolicy semantics)
+    shield_retry_attempts: int = 2
+    shield_retry_backoff_s: float = 0.05
     mesh_dp: int = 1                               # data-parallel axis (incidents)
     mesh_graph: int = 1                            # graph-parallel axis (node shards)
     node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)

@@ -122,6 +122,23 @@ class EvidenceGraphStore:
             self._version += 1
         return n
 
+    def touch_nodes(self, node_ids: Iterable[str]) -> int:
+        """Journal a ``node~`` record for nodes whose property bags were
+        mutated in place (the kube-state delta path,
+        simulator/stream.sync_touched_to_store, updates dicts directly for
+        speed and bypasses upsert): journal consumers — streaming sync()
+        and the graft-shield write-ahead log — re-extract features for
+        touched nodes, so in-place mutations stay recoverable too."""
+        n = 0
+        with self._lock:
+            for nid in node_ids:
+                if nid in self._nodes:
+                    self._jrec("node~", nid)
+                    n += 1
+            if n:
+                self._version += 1
+        return n
+
     def upsert_relations(self, relations: Iterable[GraphRelation]) -> int:
         """Batch MERGE of edges (reference neo4j.py:145-166). Edges whose
         endpoints don't exist yet get placeholder nodes (MERGE semantics)."""

@@ -210,3 +210,36 @@ SERVE_DEFERRED_FETCHES = REGISTRY.counter(
 SERVE_FETCHED_BYTES = REGISTRY.counter(
     "aiops_serve_fetched_bytes_total",
     "Bytes actually moved device->host by serving fetches, by path label")
+
+# graft-shield instrumentation (rca/shield.py + rca/journal.py): the
+# crash-consistent recovery layer over the donated serving state. Every
+# degradation-tier transition and recovery action is counted — a recovery
+# path that cannot be observed cannot be trusted (auditable-RCA bar).
+SHIELD_SNAPSHOTS = REGISTRY.counter(
+    "aiops_shield_snapshots_total",
+    "Resident-state snapshots written (atomic temp+fsync+rename)")
+SHIELD_JOURNAL_BYTES = REGISTRY.counter(
+    "aiops_shield_journal_bytes_total",
+    "Bytes appended (fsync'd) to the write-ahead delta journal")
+SHIELD_REPLAYED_DELTAS = REGISTRY.counter(
+    "aiops_shield_replayed_deltas_total",
+    "Store-journal records re-applied from the WAL during recovery")
+SHIELD_QUARANTINED_DELTAS = REGISTRY.counter(
+    "aiops_shield_quarantined_deltas_total",
+    "Delta batches quarantined after producing non-finite verdicts "
+    "(journaled as quarantined, re-ticked from replayed clean state)")
+SHIELD_WATCHDOG_TRIPS = REGISTRY.counter(
+    "aiops_shield_watchdog_trips_total",
+    "Ticks that exceeded the per-tick watchdog timeout")
+SHIELD_TIER_TRANSITIONS = REGISTRY.counter(
+    "aiops_shield_tier_transitions_total",
+    "Degradation-ladder transitions by tier label (retry, "
+    "kernel_fallback, sync_depth1, journal_replay, full_rebuild, "
+    "rules_fallback, ladder_rebuild)")
+SHIELD_RECOVERIES = REGISTRY.counter(
+    "aiops_shield_recoveries_total",
+    "Recoveries completed, by mode label (journal_replay | full_rebuild)")
+SHIELD_NONFINITE_VERDICTS = REGISTRY.counter(
+    "aiops_shield_nonfinite_verdicts_total",
+    "Verdict fetches rejected by the finite guard (NaN/inf would have "
+    "been served), by path label")

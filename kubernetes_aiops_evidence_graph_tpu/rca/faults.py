@@ -1,0 +1,173 @@
+"""Deterministic fault injection for the serving hot path (graft-shield).
+
+The shield's recovery claims are only as strong as the faults they were
+proven against, so this harness injects failures at every stage of the
+tick pipeline — from seeded schedules, so every chaos run is exactly
+reproducible from its seed (the CI chaos job echoes the seed it drew).
+
+Stages (where the hooks fire):
+
+* ``staging``        — shield delta staging, before any state mutation
+* ``dispatch``       — after the pending deltas are packed and drained,
+                       before the fused tick runs (the staged values are
+                       lost: recovery MUST replay, a bare retry cannot)
+* ``execute``        — after the tick ran and the donated handles were
+                       swapped (a device error / preemption mid-pipeline);
+                       ``device_loss`` additionally corrupts the resident
+                       arrays, simulating the donated buffers dying
+* ``fetch``          — the device→host readback failed (state is intact:
+                       an empty re-tick re-serves it)
+* ``journal_append`` / ``snapshot_write`` — torn writes via the
+                       rca/journal.py fault hook (crash mid-record)
+* ``delta_values``   — value poisoning: NaN/inf stamped into the staged
+                       feature rows (the finite guard must quarantine)
+* ``stall``          — the tick completes but only after sleeping past the
+                       watchdog timeout (fires at the ``execute`` hook)
+
+Faults address the Nth *visit* of their stage and can repeat for several
+consecutive visits (``repeats``) to force the shield past bounded retry
+into the deeper degradation tiers.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+import numpy as np
+
+from ..observability import get_logger
+
+log = get_logger("shield.faults")
+
+STAGES = ("staging", "dispatch", "execute", "fetch",
+          "journal_append", "snapshot_write", "delta_values")
+
+# value-corruption stages return poisoned data instead of raising
+_POISON_STAGES = frozenset({"delta_values"})
+
+
+class InjectedFault(RuntimeError):
+    """A scheduled failure. ``stage`` tells the shield what is suspect:
+    faults at ``staging``/``journal_append``/``snapshot_write``/``fetch``
+    leave the resident state coherent (bounded retry is sound); faults at
+    ``dispatch``/``execute`` mean staged deltas or the donated state
+    itself are gone and only journal-replay recovery restores parity."""
+
+    def __init__(self, stage: str, kind: str, visit: int):
+        super().__init__(f"injected {kind} fault at {stage} (visit {visit})")
+        self.stage = stage
+        self.kind = kind
+        self.visit = visit
+
+
+@dataclass(frozen=True)
+class Fault:
+    stage: str          # one of STAGES
+    at: int             # fires on the Nth visit of the stage (0-based)
+    kind: str = "raise"  # raise | device_loss | corrupt_silent | poison | stall
+    repeats: int = 1    # consecutive visits that fail (escalation depth)
+
+
+class FaultInjector:
+    """Deterministic schedule of Faults, consulted at the named hook
+    points (scorer ``_fault_point``/``_fault_value`` + the journal's
+    ``fault_hook``). Stateless apart from per-stage visit counters, so a
+    replay of the same script with the same schedule faults identically."""
+
+    def __init__(self, faults: Iterable[Fault] = (),
+                 stall_seconds: float = 0.0) -> None:
+        self.faults = list(faults)
+        self.stall_seconds = stall_seconds
+        self.visits: dict[str, int] = {}
+        self.fired: list[tuple[str, str, int]] = []
+
+    @classmethod
+    def seeded(cls, seed: int, ticks: int, rate: float = 0.25,
+               stages: tuple[str, ...] = STAGES,
+               stall_seconds: float = 0.0) -> "FaultInjector":
+        """Randomized-but-reproducible schedule: each stage draws fault
+        visits over ``[0, ticks)`` at ``rate``. The same seed always
+        yields the same schedule — chaos runs log the seed so any failure
+        reproduces exactly."""
+        rng = np.random.default_rng(seed)
+        faults: list[Fault] = []
+        for stage in stages:
+            hits = rng.random(ticks) < rate
+            for at in np.nonzero(hits)[0]:
+                if stage == "delta_values":
+                    kind = "poison"
+                elif stage == "execute" and rng.random() < 0.5:
+                    kind = "device_loss"
+                else:
+                    kind = "raise"
+                faults.append(Fault(stage=stage, at=int(at), kind=kind))
+        return cls(faults, stall_seconds=stall_seconds)
+
+    def _due(self, stage: str) -> "Fault | None":
+        visit = self.visits.get(stage, 0)
+        self.visits[stage] = visit + 1
+        for f in self.faults:
+            if f.stage == stage and f.at <= visit < f.at + f.repeats:
+                return f
+        return None
+
+    # -- hook API (scorer/_shield/journal call these) ----------------------
+
+    def at(self, stage: str, scorer: Any = None) -> None:
+        """Raise (or corrupt-then-raise, or stall) if a fault is due at
+        this visit of ``stage``; no-op otherwise."""
+        f = self._due(stage)
+        if f is None:
+            return
+        visit = self.visits[stage] - 1
+        self.fired.append((stage, f.kind, visit))
+        log.warning("fault_injected", stage=stage, kind=f.kind, visit=visit)
+        if f.kind == "stall":
+            time.sleep(self.stall_seconds)
+            return                      # completes, but past the watchdog
+        if f.kind == "corrupt_silent" and scorer is not None:
+            # the nastiest class: the device state dies but nothing
+            # raises — only the finite guard at the verdict boundary can
+            # catch it before garbage serves
+            self._corrupt_resident(scorer)
+            return
+        if f.kind == "device_loss" and scorer is not None:
+            self._corrupt_resident(scorer)
+        raise InjectedFault(stage, f.kind, visit)
+
+    def poison(self, stage: str, value: np.ndarray) -> np.ndarray:
+        """Return ``value`` with NaN/inf stamped in if a poison fault is
+        due; the original array otherwise."""
+        f = self._due(stage)
+        if f is None or f.kind != "poison":
+            return value
+        visit = self.visits[stage] - 1
+        self.fired.append((stage, "poison", visit))
+        log.warning("fault_injected", stage=stage, kind="poison", visit=visit)
+        bad = np.array(value, copy=True)
+        if bad.size:
+            # whole rows go non-finite: any poisoned row that is (or ever
+            # becomes) evidence WILL surface at the verdict boundary — the
+            # finite guard must catch it, not column luck
+            bad.fill(np.nan)
+            bad.reshape(-1)[0] = np.inf
+        return bad
+
+    def journal_hook(self, stage: str) -> None:
+        """Adapter with the rca/journal.py ``fault_hook`` signature."""
+        self.at(stage)
+
+    # -- corruption --------------------------------------------------------
+
+    @staticmethod
+    def _corrupt_resident(scorer: Any) -> None:
+        """Simulate the donated resident buffers dying with the device:
+        the feature matrix (the only f32 resident input every verdict
+        folds) is replaced by NaNs, so any path that keeps serving from
+        this state is guaranteed to be caught by the finite guard."""
+        import jax.numpy as jnp
+        feats = getattr(scorer, "_features_dev", None)
+        if feats is not None:
+            scorer._features_dev = jnp.full(
+                feats.shape, jnp.nan, dtype=feats.dtype)

@@ -65,11 +65,13 @@ _EdgeKey = tuple[str, str, int]   # (src_id, dst_id, kind) — store edge key
 
 
 @partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
-                                   "slices_sorted", "compute_dtype"),
+                                   "slices_sorted", "compute_dtype",
+                                   "pallas"),
          donate_argnums=(2, 3, 4, 5, 6, 7))
 def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
               pk: int, ek: int, pi: int, rel_offsets=None,
-              slices_sorted: bool = False, compute_dtype=None):
+              slices_sorted: bool = False, compute_dtype=None,
+              pallas: bool = False):
     """Apply the packed aux/edge deltas to the resident arrays, then run
     the full forward. The resident mirror (kind/nmask + the four edge
     arrays) is DONATED — the caller replaces its handles with the
@@ -113,7 +115,8 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
                          esrc, edst, erel, emask, inc_nodes,
                          rel_offsets=rel_offsets,
                          slices_sorted=slices_sorted,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype,
+                         pallas=pallas)
     probs = jax.nn.softmax(logits, axis=-1)
     # mask dead incident rows so a stale row can never surface a score
     probs = probs * inc_mask[:, None]
@@ -150,6 +153,11 @@ class GnnStreamingScorer(StreamingScorer):
         cfg = settings or get_settings()
         self._use_bucketed = bool(getattr(cfg, "gnn_bucketed", True))
         self._compute_dtype = getattr(cfg, "gnn_compute_dtype", "") or None
+        # Pallas serving tier on the STREAMING path too (settings.gnn_pallas):
+        # bit-identical to the XLA kernel, so the shield's kernel-fallback
+        # degradation tier (Pallas→XLA on repeated device faults) cannot
+        # change verdicts — only the lowering that produces them
+        self._use_pallas = bool(getattr(cfg, "gnn_pallas", False))
         super().__init__(store, settings, mesh=mesh, now_s=now_s)
 
     def _tick_statics(self, rel_offsets=None, slices_sorted=None) -> dict:
@@ -167,6 +175,7 @@ class GnnStreamingScorer(StreamingScorer):
             "slices_sorted": bool(ss) if self._use_bucketed else False,
             "compute_dtype": self._compute_dtype if self._use_bucketed
             else None,
+            "pallas": self._use_pallas if self._use_bucketed else False,
         }
 
     # -- mirror (re)initialisation ---------------------------------------
@@ -318,6 +327,15 @@ class GnnStreamingScorer(StreamingScorer):
             self._mirror_init()
             self._gnn_seq = self.store.journal_seq
             return
+        self._apply_edge_records(recs)
+        self._gnn_seq = max(seq, self._gnn_seq)
+
+    def _apply_edge_records(self, recs: list) -> None:
+        """Mirror one batch of store-journal records onto the edge mirror.
+        Shared by the live drain above and the shield's write-ahead-log
+        replay (rca/shield.py): replaying the same records through the
+        same slot allocator reproduces the mirror bit-identically (free
+        lists are part of the snapshot). Caller owns the cursor."""
         for rec in recs:
             op = rec[1]
             if op == "edge+":
@@ -329,7 +347,6 @@ class GnnStreamingScorer(StreamingScorer):
                 # records; mirror the cascade from the adjacency
                 for key in list(self._node_edges.get(rec[2], ())):
                     self._mirror_del(key)
-        self._gnn_seq = max(seq, self._gnn_seq)
 
     # -- scoring -----------------------------------------------------------
 
@@ -390,6 +407,25 @@ class GnnStreamingScorer(StreamingScorer):
         both the completion signal and the deferred-fetch surface."""
         return self._last_gnn
 
+    # -- graft-shield seams (snapshot/restore) -----------------------------
+
+    _HOST_STATE_ATTRS = StreamingScorer._HOST_STATE_ATTRS + (
+        "_gnn_seq", "_rel_offsets", "_slices_sorted",
+        "_edge_slot", "_node_edges", "_free_edge_slots", "_pending_edges",
+    )
+
+    def _resident_arrays(self) -> list:
+        return super()._resident_arrays() + [
+            self._kind_dev, self._nmask_dev, self._esrc_dev,
+            self._edst_dev, self._erel_dev, self._emask_dev]
+
+    def _adopt_resident(self, parts: tuple) -> None:
+        super()._adopt_resident(parts)
+        (self._kind_dev, self._nmask_dev, self._esrc_dev, self._edst_dev,
+         self._erel_dev, self._emask_dev) = (jnp.asarray(p)
+                                             for p in parts[4:])
+        self._last_gnn = None
+
     def _pending_delta_count(self) -> int:
         # each pending edge entry is one directed slot in the packed delta
         return super()._pending_delta_count() + len(self._pending_edges)
@@ -429,6 +465,7 @@ class GnnStreamingScorer(StreamingScorer):
         self._supersede_inflight()
         dispatch_s = time.perf_counter() - t1
         t2 = time.perf_counter()
+        self._fault_point("fetch")
         probs = np.asarray(jax.device_get(self._last_gnn[1]))
         fetch_s = time.perf_counter() - t2
         self.fetches += 1

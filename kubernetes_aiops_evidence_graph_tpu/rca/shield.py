@@ -1,0 +1,606 @@
+"""graft-shield: crash-consistent recovery + graceful degradation for the
+donated serving state.
+
+PR 5 donated the resident mirror through the jitted ticks — fast, and
+fragile: a device fault, a poisoned delta, or an executor crash mid-tick
+destroys the ONLY copy of the state, and the sole fallback was a full
+``_rebuild()`` (re-tensorize the world, drop every in-flight tick). The
+reference platform got durability for free from Temporal; this layer
+reproduces that bar for the device-resident scorer with three pillars:
+
+1. **Crash-consistent recovery.** Every store-journal record batch is
+   appended to a host-side write-ahead journal (rca/journal.py — fsync'd,
+   crc-framed, O(delta)) BEFORE it is applied to the donated state, and
+   the full resident state snapshots every N generation boundaries (the
+   double-buffered queue makes the post-rescore boundary a natural atomic
+   point: pending drained, in-flight superseded). Recovery = load last
+   snapshot + replay the journal suffix through the SAME mutation code
+   path serving uses (``_apply_records``/``_apply_edge_records``), which
+   reproduces row allocation, widths, and device state bit-identically —
+   and strictly cheaper than ``_rebuild()``.
+
+2. **Deterministic fault injection.** rca/faults.py drives every stage of
+   the tick pipeline from seeded schedules; tests/test_shield.py proves
+   recovery parity under each fault class and under randomized schedules
+   at pipeline depths 1 and 2.
+
+3. **Graceful-degradation ladder + watchdog.** Transient faults get
+   bounded retry with seeded-jitter exponential backoff (the
+   workflow/engine.RetryPolicy semantics); persistent ones walk the
+   ladder: kernel fallback (Pallas→XLA — bit-identical, PR 4), pipeline
+   fallback (async depth-N → sync depth-1 — bit-identical, PR 5),
+   journal-replay recovery, full store-derived rebuild, and finally (GNN
+   only) fallback to the rules scorer. Every transition is counted in
+   observability/metrics.py and surfaced in the rescore() result. A
+   finite guard rejects NaN/inf verdicts before they serve: the staged
+   batch is journaled as quarantined and the tick replays from
+   store-truth state (the poison lived in the staged values, never in
+   the store).
+
+Fault-stage semantics (what a bare retry may assume): ``staging``,
+``journal_append``, ``snapshot_write`` and ``fetch`` faults leave the
+resident state coherent — an empty re-tick re-serves it, so bounded retry
+is sound. ``dispatch``/``execute`` faults mean the drained deltas or the
+donated buffers themselves are gone; every ladder step taken for those is
+paired with a journal replay, because no configuration change can restage
+lost state.
+
+The snapshot fetch/restore kernels (``_snapshot_pack``/``_snapshot_unpack``)
+are registered audit entrypoints (analysis/registry.py) with an explicit
+zero-collective CostSpec: the recovery path is pinned by the same
+graft-audit/cost substrate as the serving path, not trusted.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+import uuid
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Settings, get_settings
+from ..observability import get_logger
+from ..observability import metrics as obs_metrics
+from ..workflow.engine import NonRetryableError, RetryPolicy
+from .journal import DeltaJournal
+from .streaming import NonFiniteDelta
+
+log = get_logger("shield")
+
+# fault stages after which the resident state is still coherent — see
+# module docstring; everything else is state-suspect
+_RETRIABLE_STAGES = frozenset(
+    {"staging", "journal_append", "snapshot_write", "fetch"})
+
+# the degradation ladder, in escalation order
+LADDER = ("kernel_fallback", "sync_depth1", "journal_replay",
+          "full_rebuild", "rules_fallback")
+
+
+@jax.jit
+def _snapshot_pack(*arrays):
+    """Pack the resident device buffers into ONE flat int32 buffer for the
+    snapshot fetch: float tables bitcast to int32 (bit-exact, NaN payloads
+    included), everything raveled and concatenated — so a snapshot pays a
+    single device→host transfer regardless of how many mirrors the scorer
+    carries (the dev tunnel charges per transfer, same economics as the
+    packed tick delta)."""
+    flat = []
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            a = jax.lax.bitcast_convert_type(a, jnp.int32)
+        flat.append(a.reshape(-1))
+    return jnp.concatenate(flat)
+
+
+@partial(jax.jit, static_argnames=("layout",))
+def _snapshot_unpack(flat, layout):
+    """Inverse of :func:`_snapshot_pack`. ``layout`` is a static tuple of
+    ``(shape, dtype_name)`` pairs recorded at pack time; float buffers are
+    bitcast back, so restore is bit-exact."""
+    out = []
+    off = 0
+    for shp, dt in layout:
+        n = 1
+        for d in shp:
+            n *= d
+        seg = flat[off:off + n].reshape(shp)
+        off += n
+        if dt == "float32":
+            seg = jax.lax.bitcast_convert_type(seg, jnp.float32)
+        out.append(seg)
+    return tuple(out)
+
+
+class NonFiniteVerdict(RuntimeError):
+    """The finite guard rejected a verdict fetch: serving NaN/inf to the
+    workflow would poison hypotheses, approvals, and remediation scoring
+    downstream. Treated as state-suspect (the poison already scattered
+    into the donated state), so recovery replays from store truth."""
+
+    stage = "verdict"
+
+
+class ShieldedScorer:
+    """Fault-tolerance wrapper around a resident Streaming/GnnStreaming
+    scorer: write-ahead journaling, periodic snapshots, watchdog, bounded
+    retry, and the degradation ladder. Unknown attributes delegate to the
+    wrapped scorer, so the workflow worker and tests use it as a drop-in.
+
+    Serving drivers must mutate the STORE and let the shield drain the
+    store journal (``serve()``/``rescore()``/``tick()``/``sync()``) — the
+    write-ahead journal can only cover what flows through it; direct
+    scorer mutation calls bypass durability (the bench's raw hot-loop
+    mode does this deliberately and is documented as unshielded).
+    """
+
+    def __init__(self, scorer, settings: "Settings | None" = None,
+                 directory: "str | None" = None, injector=None) -> None:
+        self.scorer = scorer
+        self.settings = settings or get_settings()
+        self.injector = injector
+        scorer.fault_injector = injector
+        scorer.finite_delta_guard = True
+        d = directory or self.settings.shield_dir or os.path.join(
+            ".kaeg_shield", str(os.getpid()))
+        self.journal = DeltaJournal(
+            d, fault_hook=injector.journal_hook if injector else None,
+            fsync_every=getattr(self.settings,
+                                "shield_wal_fsync_every_ticks", 1))
+        self.retry = RetryPolicy(
+            max_attempts=max(int(self.settings.shield_retry_attempts), 0),
+            initial_interval_s=float(self.settings.shield_retry_backoff_s),
+            backoff=2.0, max_interval_s=5.0)
+        self.snapshot_every = max(
+            int(self.settings.shield_snapshot_every_ticks), 1)
+        self.tick_timeout_s = float(self.settings.shield_tick_timeout_s)
+        self._lock = threading.RLock()
+        # store-lineage token: a snapshot only restores onto the store it
+        # was captured from (stamped on the store object; files from a
+        # different lineage are ignored and recovery falls back to rebuild)
+        store = scorer.store
+        tok = getattr(store, "_shield_epoch", None)
+        if tok is None:
+            tok = uuid.uuid4().hex
+            store._shield_epoch = tok
+        self._epoch = tok
+        # observability / test surface
+        self.tier = "steady"
+        self.tier_log: list[str] = []
+        self.snapshots = 0
+        self.recoveries = 0
+        self.replayed_records = 0
+        self.quarantined_batches = 0
+        self.watchdog_trips = 0
+        self.last_recovery_seconds = 0.0
+        self._journal_seconds = 0.0
+        self.journal_seconds_total = 0.0
+        self._ticks_since_snapshot = 0
+        self._last_batch = (0, 0)
+        self._fallback_from = None      # the GNN scorer rules_fallback shed
+        self._snap_thread: "threading.Thread | None" = None
+        self.last_capture_seconds = 0.0
+        self.last_snapshot_seconds = 0.0
+
+    # -- delegation --------------------------------------------------------
+
+    def __getattr__(self, name):
+        try:
+            scorer = object.__getattribute__(self, "scorer")
+        except AttributeError:
+            raise AttributeError(name) from None
+        return getattr(scorer, name)
+
+    # -- protected serving API --------------------------------------------
+
+    def serve(self) -> dict:
+        """Journal + sync + rescore under the shield lock. Callers are
+        serialized here (the shield must observe every failure), so each
+        caller's prior store writes are drained by its own staging pass —
+        the same visibility guarantee scorer.serve()'s generation protocol
+        gives concurrent callers."""
+        return self.rescore()
+
+    def rescore(self) -> dict:
+        with self._lock:
+            return self._run_with_recovery(self._tick_rescore)
+
+    def tick(self) -> dict:
+        """Protected pipelined submission (scorer.tick_async)."""
+        with self._lock:
+            return self._run_with_recovery(self._tick_async)
+
+    def sync(self) -> dict:
+        """Journal + apply only (no dispatch) — for drivers that tick
+        elsewhere."""
+        with self._lock:
+            return self._run_with_recovery(self._stage_and_apply)
+
+    # -- the guarded tick --------------------------------------------------
+
+    def _tick_rescore(self) -> dict:
+        self._stage_and_apply()
+        out = self.scorer.rescore()
+        self._finite_guard(out)
+        self._ticks_since_snapshot += 1
+        if self._ticks_since_snapshot >= self.snapshot_every:
+            self.snapshot_now(background=True)
+        # recovery visibility in the rescore timing splits
+        out["shield_tier"] = self.tier
+        out["journal_seconds"] = self._journal_seconds
+        out["recovery_seconds"] = self.last_recovery_seconds
+        out["recoveries"] = self.recoveries
+        out["quarantined_batches"] = self.quarantined_batches
+        out["watchdog_trips"] = self.watchdog_trips
+        return out
+
+    def _tick_async(self) -> dict:
+        self._stage_and_apply()
+        out = self.scorer.tick_async()
+        self._ticks_since_snapshot += 1
+        if self._ticks_since_snapshot >= self.snapshot_every:
+            self.snapshot_now(background=True)
+        return out
+
+    def _stage_and_apply(self) -> dict:
+        """Drain the store journal, write-ahead the batch (fsync, BEFORE
+        any state mutation — the crash-consistency invariant), then apply
+        it through the scorer's mutation path."""
+        s = self.scorer
+        if self.injector is not None:
+            self.injector.at("staging", s)
+        recs, seq, truncated = s.store.journal_since(s._synced_seq)
+        if truncated:
+            # the bounded store journal evicted unseen records: only a
+            # store-derived rebuild is sound (same fallback as sync())
+            self._transition("full_rebuild")
+            s._rebuild()
+            obs_metrics.SHIELD_RECOVERIES.inc(mode="full_rebuild")
+            self.recoveries += 1
+            self._ticks_since_snapshot = self.snapshot_every
+            s.syncs += 1
+            return {"applied": 0, "rebuilt": True}
+        lo = s._synced_seq
+        if recs:
+            t0 = time.perf_counter()
+            nbytes = self.journal.append(recs, lo, seq)
+            self._journal_seconds = time.perf_counter() - t0
+            self.journal_seconds_total += self._journal_seconds
+            obs_metrics.SHIELD_JOURNAL_BYTES.inc(float(nbytes))
+        self._last_batch = (lo, seq)
+        res = s._apply_records(recs)
+        s.syncs += 1
+        if res.get("rebuilt"):
+            # _init_from_store re-derived everything from the store and
+            # advanced the cursors past this batch; the pre-rebuild
+            # snapshot is stale — refresh at the next boundary
+            self._ticks_since_snapshot = self.snapshot_every
+            return res
+        s._synced_seq = max(seq, s._synced_seq)
+        if hasattr(s, "_apply_edge_records"):
+            s._apply_edge_records(recs)
+            s._gnn_seq = max(seq, s._gnn_seq)
+        return res
+
+    def _finite_guard(self, out: dict) -> None:
+        for k in ("probs", "scores", "top_score", "top_confidence"):
+            v = out.get(k)
+            if v is None:
+                continue
+            a = np.asarray(v)
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                obs_metrics.SHIELD_NONFINITE_VERDICTS.inc(path="shield")
+                raise NonFiniteVerdict(f"non-finite verdict field {k!r}")
+
+    # -- failure handling: retry + degradation ladder ----------------------
+
+    # a guarded call walks the ladder at most this many times before the
+    # failure surfaces: a fault persisting through repeated recoveries,
+    # rebuilds, and (for GNN) the rules fallback is an outage, not a blip
+    MAX_LADDER_ROUNDS = 3
+
+    def _run_with_recovery(self, fn):
+        """Run one guarded operation; failures walk the bounded-retry +
+        degradation ladder until the operation succeeds or the ladder is
+        exhausted. Watchdog checks the successful path's wall time."""
+        state = {"applied": set(), "rounds": 0, "failures": 0}
+        while True:
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+            except Exception as exc:
+                state["failures"] += 1
+                self._escalate(exc, state)
+                continue
+            self._watchdog(time.perf_counter() - t0)
+            if state["failures"] and self.tier != "rules_fallback":
+                self.tier = "steady"
+            return out
+
+    def _escalate(self, exc: Exception, state: dict) -> None:
+        """Pick and apply the next ladder step for this failure. Raises
+        when the error is non-retryable (programming errors must surface,
+        not degrade) or the ladder rounds are exhausted."""
+        if isinstance(exc, (ValueError, TypeError, NonRetryableError)):
+            raise exc
+        stage = getattr(exc, "stage", "")
+        suspect = stage not in _RETRIABLE_STAGES
+        log.warning("guarded_tick_failed", stage=stage or "unknown",
+                    error=str(exc), failures=state["failures"],
+                    suspect=suspect)
+        if isinstance(exc, (NonFiniteVerdict, NonFiniteDelta)):
+            # NonFiniteDelta: poison caught at the dispatch boundary
+            # before the scatter; NonFiniteVerdict: the backstop at the
+            # fetch boundary (e.g. silent device corruption). Both
+            # quarantine the offending batch — replay serves store truth.
+            lo, hi = self._last_batch
+            self.journal.mark_quarantined(lo, hi, reason=str(exc))
+            self.quarantined_batches += 1
+            obs_metrics.SHIELD_QUARANTINED_DELTAS.inc()
+        if not suspect and state["failures"] <= self.retry.max_attempts:
+            # transient, state coherent: bounded retry with seeded-jitter
+            # backoff (key = store lineage + batch, so concurrent shields
+            # de-synchronize while a replay of one shield sleeps the same)
+            self._transition("retry")
+            time.sleep(self.retry.delay(
+                state["failures"],
+                key=f"{self._epoch}:{self._last_batch[1]}"))
+            return
+        applied = state["applied"]
+        while True:
+            for step in LADDER:
+                if step in applied:
+                    continue
+                applied.add(step)
+                if self._apply_ladder_step(step, suspect):
+                    return
+            # every rung tried this round: a dense fault schedule may
+            # outlast one pass (recoveries restore a consistent state, so
+            # re-walking the ladder is sound) — but only boundedly
+            state["rounds"] += 1
+            if state["rounds"] >= self.MAX_LADDER_ROUNDS:
+                raise exc
+            applied.clear()
+
+    def _apply_ladder_step(self, step: str, suspect: bool) -> bool:
+        """Apply one degradation rung; False = not applicable here (or
+        recovery unavailable), caller escalates to the next rung.
+        State-suspect failures pair every configuration-only rung with a
+        journal replay — no config change can restage lost deltas."""
+        if step == "kernel_fallback":
+            if not getattr(self.scorer, "_use_pallas", False):
+                return False
+            # Pallas -> XLA is bit-identical (PR 4): degrading the
+            # lowering can change which kernel faults, never verdicts
+            self.scorer._use_pallas = False
+            self._transition(step)
+            if suspect:
+                self._try_recover()
+            return True
+        if step == "sync_depth1":
+            if self.scorer.pipeline_depth <= 1:
+                return False
+            # depth parity is bit-identical (PR 5): dropping to the
+            # serialized loop narrows the blast radius of device faults
+            # to one tick without changing results
+            self.scorer.pipeline_depth = 1
+            self.scorer._supersede_inflight()
+            self._transition(step)
+            if suspect:
+                self._try_recover()
+            return True
+        if step == "journal_replay":
+            self._transition(step)
+            return self._try_recover()
+        if step == "full_rebuild":
+            self._transition(step)
+            self.scorer._rebuild()
+            obs_metrics.SHIELD_RECOVERIES.inc(mode="full_rebuild")
+            self.recoveries += 1
+            self._ticks_since_snapshot = self.snapshot_every
+            return True
+        if step == "rules_fallback":
+            if not self._engage_rules_fallback():
+                return False
+            self._transition(step)
+            return True
+        return False
+
+    def _try_recover(self) -> bool:
+        """Journal-replay recovery as a ladder step: a failure here (bad
+        snapshot, injected fault mid-recovery) reports False so the
+        caller escalates to the deeper tiers instead of wedging."""
+        try:
+            self.recover()
+            return True
+        except (RuntimeError, OSError, KeyError, pickle.PickleError) as exc:
+            log.error("recovery_failed", error=str(exc))
+            return False
+
+    def _watchdog(self, elapsed_s: float) -> None:
+        if not self.tick_timeout_s or elapsed_s <= self.tick_timeout_s:
+            return
+        # an XLA dispatch cannot be cancelled host-side: the watchdog
+        # bounds RECURRENCE — count the trip and drop to the serialized
+        # depth-1 loop so at most one tick is ever exposed to a slow or
+        # wedged device
+        self.watchdog_trips += 1
+        obs_metrics.SHIELD_WATCHDOG_TRIPS.inc()
+        log.warning("watchdog_trip", elapsed_s=round(elapsed_s, 3),
+                    timeout_s=self.tick_timeout_s)
+        if self.scorer.pipeline_depth > 1:
+            self.scorer.pipeline_depth = 1
+            self.scorer._supersede_inflight()
+            self._transition("sync_depth1")
+
+    def _engage_rules_fallback(self) -> bool:
+        """Last functional tier for a GNN scorer that cannot be revived:
+        serve rules verdicts from a fresh StreamingScorer over the same
+        store (shared result fields: top_rule_index / any_match /
+        top_confidence). The faulting scorer is shed; the injector does
+        NOT follow — the fallback must actually serve."""
+        from .gnn_streaming import GnnStreamingScorer
+        from .streaming import StreamingScorer
+        if not isinstance(self.scorer, GnnStreamingScorer):
+            return False
+        old = self.scorer
+        old.stop_warm(join=False)
+        fallback = StreamingScorer(old.store, self.settings,
+                                   now_s=old.now_s)
+        fallback.finite_delta_guard = True
+        self._fallback_from = old
+        self.scorer = fallback
+        self._ticks_since_snapshot = self.snapshot_every
+        log.error("rules_fallback_engaged")
+        return True
+
+    def _transition(self, tier: str) -> None:
+        self.tier = tier
+        self.tier_log.append(tier)
+        obs_metrics.SHIELD_TIER_TRANSITIONS.inc(tier=tier)
+
+    # -- snapshots + recovery ---------------------------------------------
+
+    def snapshot_now(self, background: bool = False) -> int:
+        """Capture the full resident state (host bookkeeping + packed
+        device arrays, ONE device→host transfer) and persist it
+        atomically, then compact the WAL to the uncovered suffix.
+
+        The CAPTURE is synchronous under serve_lock (a consistent cut of
+        host + device state, ~O(resident bytes) of memcpy). With
+        ``background=True`` (the cadence path) the persist — write +
+        fsync + rename + compact, the disk-bound bulk of the cost — runs
+        on a writer thread while serving continues; recovery and the next
+        snapshot join it first. Returns bytes written (0 when deferred to
+        the writer thread)."""
+        self._join_snapshot_writer()
+        s = self.scorer
+        t0 = time.perf_counter()
+        with s.serve_lock:
+            arrays = s._resident_arrays()
+            layout = tuple((tuple(int(d) for d in a.shape), str(a.dtype))
+                           for a in arrays)
+            flat = jax.device_get(_snapshot_pack(*arrays))
+            host = pickle.dumps(s.capture_host_state(),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            store_seq = int(s._synced_seq)
+        self.last_capture_seconds = time.perf_counter() - t0
+        state = {"epoch": self._epoch, "store_seq": store_seq,
+                 "klass": type(s).__name__, "layout": layout,
+                 "flat": flat, "host": host}
+        self.snapshots += 1
+        self._ticks_since_snapshot = 0
+        obs_metrics.SHIELD_SNAPSHOTS.inc()
+        if background:
+            self._snap_thread = threading.Thread(
+                target=self._persist_snapshot, args=(state, t0),
+                name="kaeg-shield-snapshot", daemon=False)
+            self._snap_thread.start()
+            return 0
+        return self._persist_snapshot(state, t0)
+
+    def _persist_snapshot(self, state: dict, t0: float) -> int:
+        try:
+            nbytes = self.journal.write_snapshot(state)
+            self.journal.compact(state["store_seq"])
+        except (OSError, RuntimeError) as exc:
+            # a failed persist leaves the previous snapshot intact; the
+            # next cadence (or recovery-time rebuild) covers the gap
+            log.error("snapshot_persist_failed", error=str(exc))
+            return 0
+        self.last_snapshot_seconds = time.perf_counter() - t0
+        log.info("snapshot_written", bytes=nbytes,
+                 store_seq=state["store_seq"])
+        return nbytes
+
+    def _join_snapshot_writer(self) -> None:
+        t = self._snap_thread
+        if t is not None and t.is_alive():
+            t.join()
+
+    def recover(self) -> dict:
+        """Load the last durable snapshot and replay the journal suffix —
+        bit-identical to the pre-fault state, strictly cheaper than a
+        rebuild. Falls back to the store-derived ``_rebuild()`` when no
+        snapshot of this store lineage exists."""
+        self._join_snapshot_writer()
+        t0 = time.perf_counter()
+        s = self.scorer
+        state = self.journal.load_snapshot()
+        if (state is None or state.get("epoch") != self._epoch
+                or state.get("klass") != type(s).__name__):
+            s._rebuild()
+            dt = time.perf_counter() - t0
+            self.recoveries += 1
+            self.last_recovery_seconds = dt
+            self._ticks_since_snapshot = self.snapshot_every
+            obs_metrics.SHIELD_RECOVERIES.inc(mode="full_rebuild")
+            log.warning("recovered_via_rebuild", seconds=round(dt, 4))
+            return {"mode": "full_rebuild", "replayed": 0, "seconds": dt}
+        replayed = 0
+        with s.serve_lock:
+            s.restore_host_state(pickle.loads(state["host"]))
+            parts = _snapshot_unpack(jnp.asarray(state["flat"]),
+                                     layout=state["layout"])
+            s._adopt_resident(parts)
+            batches, torn = self.journal.read()
+            rb0 = s.rebuilds
+            for b in batches:
+                if b.kind != "deltas" or b.seq_hi <= s._synced_seq:
+                    continue
+                s._apply_records(b.recs)
+                replayed += len(b.recs)
+                if s.rebuilds != rb0:
+                    # replay re-hit a bucket overflow: the rebuild is
+                    # store-derived as of NOW, which supersedes the rest
+                    break
+                if hasattr(s, "_apply_edge_records"):
+                    s._apply_edge_records(b.recs)
+                    s._gnn_seq = max(b.seq_hi, s._gnn_seq)
+                s._synced_seq = max(b.seq_hi, s._synced_seq)
+        dt = time.perf_counter() - t0
+        self.recoveries += 1
+        self.replayed_records += replayed
+        self.last_recovery_seconds = dt
+        obs_metrics.SHIELD_REPLAYED_DELTAS.inc(float(replayed))
+        obs_metrics.SHIELD_RECOVERIES.inc(mode="journal_replay")
+        log.warning("recovered_via_journal_replay", replayed=replayed,
+                    torn_truncated=torn, seconds=round(dt, 4))
+        return {"mode": "journal_replay", "replayed": replayed,
+                "torn_truncated": torn, "seconds": dt}
+
+    def recover_or_snapshot(self) -> dict:
+        """Scorer-acquisition hook (workflow/worker.py): restore from a
+        compatible on-disk snapshot+journal if one exists for this store
+        lineage, otherwise anchor a fresh snapshot so every later fault
+        is recoverable from tick one."""
+        with self._lock:
+            state = self.journal.load_snapshot()
+            if (state is not None and state.get("epoch") == self._epoch
+                    and state.get("klass") == type(self.scorer).__name__):
+                return self.recover()
+            return {"mode": "fresh_snapshot", "bytes": self.snapshot_now()}
+
+    def stats(self) -> dict:
+        return {
+            "tier": self.tier,
+            "tier_log": tuple(self.tier_log),
+            "snapshots": self.snapshots,
+            "recoveries": self.recoveries,
+            "replayed_records": self.replayed_records,
+            "quarantined_batches": self.quarantined_batches,
+            "watchdog_trips": self.watchdog_trips,
+            "journal_batches": self.journal.appended_batches,
+            "journal_bytes": self.journal.appended_bytes,
+            "torn_truncations": self.journal.torn_truncations,
+        }
+
+    def close(self) -> None:
+        self._join_snapshot_writer()
+        self.journal.close()

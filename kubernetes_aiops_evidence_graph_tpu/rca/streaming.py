@@ -53,8 +53,26 @@ _NO_PAIR = -1          # host-side "evidence has no scheduled node" marker
 log = get_logger("streaming")
 
 
+class NonFiniteDelta(RuntimeError):
+    """A staged feature delta carries NaN/inf rows. Scattering it would
+    poison the donated resident state — and the rules fold absorbs NaN
+    through its threshold comparisons (NaN > t is False), so the damage
+    would surface as silently WRONG verdicts, not as NaN ones. Raised
+    before the scatter (the pending deltas are already drained, so only a
+    journal replay restages them — ``stage`` marks the state suspect for
+    the shield's ladder, rca/shield.py, which quarantines the batch)."""
+
+    stage = "dispatch"
+
+
 class NeedsRebuild(Exception):
-    """A bucket overflowed; the caller fell back to a full rebuild."""
+    """A growth ladder is exhausted: the next width/pair-width bucket lies
+    beyond the ladder top, so in-place growth would mint an unplanned
+    off-ladder compile mid-serve. Raised by ``_grow_width``/
+    ``_grow_pair_width`` and caught by ``_grow``, which escalates to a
+    full store-derived ``_rebuild()`` (the rebuild may legitimately land
+    on an off-ladder power-of-two shape — but explicitly, store-derived,
+    through the warmable rebuild path)."""
 
 
 @partial(jax.jit, static_argnames=("padded_incidents", "pair_width",
@@ -265,6 +283,14 @@ class StreamingScorer:
         self._serve_done_gen = 0
         self._serve_ticking = False
         self._serve_result: dict | None = None
+        # graft-shield seam: when a FaultInjector (rca/faults.py) is
+        # attached, the tick pipeline consults it at each named stage —
+        # None (the default) costs one attribute read per hook. The
+        # ShieldedScorer flips finite_delta_guard on when it wraps this
+        # scorer: staged feature rows are isfinite-checked (O(delta))
+        # before they scatter into the donated state.
+        self.fault_injector = None
+        self.finite_delta_guard = False
         self._init_from_store()
 
     # -- (re)initialisation ------------------------------------------------
@@ -517,11 +543,29 @@ class StreamingScorer:
 
     # -- bucket management -------------------------------------------------
 
+    def _grow(self, grower) -> bool:
+        """Run one growth step; on ladder exhaustion (NeedsRebuild)
+        escalate to a full store-derived rebuild. Returns True when the
+        escalation rebuilt (callers must stop touching pre-growth rows)."""
+        try:
+            grower()
+            return False
+        except NeedsRebuild as exc:
+            log.warning("growth_ladder_exhausted", error=str(exc))
+            obs_metrics.SHIELD_TIER_TRANSITIONS.inc(tier="ladder_rebuild")
+            self._rebuild()
+            return True
+
     def _grow_width(self) -> None:
         """Slot-width bucket overflow: next bucket, re-ship ALL rows (new
         static shape -> new program; pays one compile in the hot loop
-        unless warm(include_next_width=True) pre-compiled it)."""
-        self.width = bucket_for(self.width + 1, _WIDTH_BUCKETS)
+        unless warm(include_next_width=True) pre-compiled it). Raises
+        NeedsRebuild past the ladder top (see _grow)."""
+        nxt = bucket_for(self.width + 1, _WIDTH_BUCKETS)
+        if nxt > _WIDTH_BUCKETS[-1]:
+            raise NeedsRebuild(
+                f"slot width {nxt} beyond ladder top {_WIDTH_BUCKETS[-1]}")
+        self.width = nxt
         pi = self.snapshot.padded_incidents
         ev_idx, ev_cnt, ev_pair = self._materialize_rows(range(pi))
         self._ev_idx_dev = jnp.asarray(ev_idx)
@@ -534,8 +578,14 @@ class StreamingScorer:
     def _grow_pair_width(self) -> None:
         """Pair bucket overflow: bump the bucket and re-stamp sentinels.
         Never shrinks mid-stream (ADVICE r1: a shrunk sentinel would land
-        in range of the wider compiled one_hot)."""
-        self.pair_width = bucket_for(self.pair_width + 1, _PAIR_WIDTH_BUCKETS)
+        in range of the wider compiled one_hot). Raises NeedsRebuild past
+        the ladder top (see _grow)."""
+        nxt = bucket_for(self.pair_width + 1, _PAIR_WIDTH_BUCKETS)
+        if nxt > _PAIR_WIDTH_BUCKETS[-1]:
+            raise NeedsRebuild(
+                f"pair width {nxt} beyond ladder top "
+                f"{_PAIR_WIDTH_BUCKETS[-1]}")
+        self.pair_width = nxt
         self._pair_dev = jnp.asarray(
             self._materialize_pairs(range(self.snapshot.padded_incidents)))
         self._apply_sharding()
@@ -708,13 +758,18 @@ class StreamingScorer:
             return True  # MERGE semantics: duplicate edge is a no-op
         if len(self._row_nodes[r]) >= self.width:
             self._append_evidence_host(r, dst)
-            self._grow_width()          # width first: the pair-growth path
-            if self._pair_overflowed(r):  # re-materializes at current width
-                self._grow_pair_width()
+            # width first: the pair-growth path re-materializes at the
+            # current width. A ladder-exhaustion rebuild supersedes row
+            # state entirely (store-derived), so stop on escalation.
+            if self._grow(self._grow_width):
+                return True
+            if self._pair_overflowed(r):
+                self._grow(self._grow_pair_width)
             return True
         self._append_evidence_host(r, dst)
         if self._pair_overflowed(r):
-            self._grow_pair_width()
+            if self._grow(self._grow_pair_width):
+                return True
         self._dirty_rows.add(r)
         return True
 
@@ -758,7 +813,7 @@ class StreamingScorer:
             if self._pair_overflowed(r):
                 grew = True
         if grew:
-            self._grow_pair_width()
+            self._grow(self._grow_pair_width)
         return True
 
     # back-compat alias (round-1 API)
@@ -800,6 +855,18 @@ class StreamingScorer:
         if truncated:
             self._rebuild()
             return {"applied": 0, "rebuilt": True}
+        res = self._apply_records(recs)
+        if not res["rebuilt"]:
+            self._synced_seq = max(seq, self._synced_seq)
+        return res
+
+    def _apply_records(self, recs: list) -> dict:
+        """Apply one batch of store-journal records through the mutation
+        API. Shared by sync() (records drained live from the store) and
+        the shield's journal replay (records re-fed from the write-ahead
+        log, rca/shield.py) — one code path is what makes replay
+        bit-identical. The caller owns cursor bookkeeping; a mid-batch
+        rebuild supersedes the batch (state is store-derived as of NOW)."""
         changed: set[str] = set()
         structural = 0
         incident_kind = int(EntityKind.INCIDENT)
@@ -858,7 +925,6 @@ class StreamingScorer:
             # applied last with CURRENT store state: latest feature wins
             # regardless of interleaving, and removed ids just skip
             self.update_nodes(changed)
-        self._synced_seq = max(seq, self._synced_seq)
         return {"applied": len(recs), "structural": structural,
                 "feature": len(changed), "rebuilt": False}
 
@@ -1121,6 +1187,18 @@ class StreamingScorer:
         ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
         f_idx, f_rows = self._pending_feature_delta()
         r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+        # graft-shield hooks: value poisoning lands on the STAGED rows
+        # (the host copy in self.snapshot stays clean — store-truth), and
+        # the dispatch fault fires after the pending deltas were drained,
+        # so a bare retry cannot restage them: journal replay must
+        f_rows = self._fault_value("delta_values", f_rows)
+        if self.finite_delta_guard and not np.isfinite(f_rows).all():
+            # O(delta) host check, not O(N): quarantine-grade poison is
+            # caught BEFORE it scatters into the donated state
+            raise NonFiniteDelta(
+                f"{int((~np.isfinite(f_rows)).any(axis=-1).sum())} "
+                "non-finite staged feature rows")
+        self._fault_point("dispatch")
         ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
         tick = self._tick_fn(self.snapshot.padded_nodes,
                              self.snapshot.padded_incidents,
@@ -1133,7 +1211,90 @@ class StreamingScorer:
         )
         (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
          self._pair_dev) = out[:4]
+        # device error / preemption mid-pipeline: the donated inputs are
+        # already dead and the outputs may be poisoned — the shield's
+        # recovery tiers are the only way back to the pre-fault state
+        self._fault_point("execute")
         return out[4:]
+
+    # -- graft-shield seams (fault injection + snapshot/restore) -----------
+
+    def _fault_point(self, stage: str) -> None:
+        inj = self.fault_injector
+        if inj is not None:
+            inj.at(stage, self)
+
+    def _fault_value(self, stage: str, value: np.ndarray) -> np.ndarray:
+        inj = self.fault_injector
+        if inj is not None:
+            return inj.poison(stage, value)
+        return value
+
+    # Host-authoritative attributes a state snapshot must carry: together
+    # with the resident device arrays they reproduce the scorer exactly
+    # (free lists included — replayed mutations must allocate the same
+    # rows for bit-identical recovery). Kept as an explicit tuple so the
+    # shield can pickle/restore without knowing scorer internals.
+    _HOST_STATE_ATTRS: tuple[str, ...] = (
+        "snapshot", "width", "pair_width", "_synced_seq",
+        "_node_ids", "_id_to_idx", "_free_node_rows",
+        "_inc_row_of", "_row_inc", "_free_inc_rows",
+        "_pod_node", "_sched_pods",
+        "_row_nodes", "_row_pairs", "_pair_map", "_ev_rows_of_node",
+        "_pending_feat", "_dirty_rows",
+    )
+
+    def capture_host_state(self) -> dict:
+        """References to the host-side serving state (the shield pickles
+        them immediately, under serve_lock, so later mutation cannot leak
+        into the snapshot).
+
+        The GraphSnapshot is captured SLIM: ``features`` is dropped (the
+        host mirror is bit-identical to the resident device buffer, which
+        the snapshot already packs — restore re-stitches it from there)
+        and the edge arrays are dropped (read only by _init_from_store;
+        a post-restore rebuild re-derives them from the store). At the
+        50k-pod config this halves snapshot bytes and capture time."""
+        import dataclasses
+        d = {k: getattr(self, k) for k in self._HOST_STATE_ATTRS}
+        d["snapshot"] = dataclasses.replace(
+            self.snapshot,
+            features=np.zeros((0, self.snapshot.features.shape[1]),
+                              np.float32),
+            edge_src=np.zeros(0, np.int32), edge_dst=np.zeros(0, np.int32),
+            edge_rel=np.zeros(0, np.int32),
+            edge_mask=np.zeros(0, np.float32))
+        return d
+
+    def restore_host_state(self, state: dict) -> None:
+        """Adopt a deserialized host-state dict (fresh objects from
+        pickle — never shared with a live scorer). The feature mirror is
+        re-stitched from the restored device buffer by _adopt_resident."""
+        for k in self._HOST_STATE_ATTRS:
+            setattr(self, k, state[k])
+        self._inflight.clear()
+
+    def _resident_arrays(self) -> list:
+        """The device-resident buffers a snapshot packs, in a fixed order
+        matching _adopt_resident. Subclasses extend with their mirrors."""
+        return [self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
+                self._pair_dev]
+
+    def _adopt_resident(self, parts: tuple) -> None:
+        """Re-install unpacked device buffers as the resident state, and
+        re-stitch the host feature mirror from the device copy (the two
+        are bit-identical by construction, so the snapshot carries the
+        features once — see capture_host_state)."""
+        (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
+         self._pair_dev) = (jnp.asarray(p) for p in parts[:4])
+        if self.snapshot.features.size == 0:
+            import dataclasses
+            self.snapshot = dataclasses.replace(
+                self.snapshot,
+                features=np.array(jax.device_get(self._features_dev)))
+        pi = self.snapshot.padded_incidents
+        self._chain0 = jnp.zeros((pi,), jnp.float32)
+        self._apply_sharding()
 
     # -- pipelined executor (graft-pipeline) -------------------------------
     #
@@ -1303,6 +1464,7 @@ class StreamingScorer:
         self._supersede_inflight()
         dispatch_s = time.perf_counter() - t1
         t2 = time.perf_counter()
+        self._fault_point("fetch")
         fetched = jax.device_get(out)
         fetch_s = time.perf_counter() - t2
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (

@@ -468,6 +468,20 @@ class TpuRcaBackend:
         obs_metrics.SERVE_FETCHED_BYTES.inc(
             float(sum(a.nbytes for a in fetched)), path="score_snapshot")
 
+        # finite guard (graft-shield): a poisoned feature row or device
+        # fault must never surface as a NaN/inf verdict — count and log so
+        # the snapshot path shares the serving path's honesty bar (the
+        # shield quarantines; this batch path has no delta to quarantine,
+        # so it surfaces the signal instead of silently serving garbage)
+        from ..observability import get_logger
+        for k, a in zip(keys, fetched):
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                obs_metrics.SHIELD_NONFINITE_VERDICTS.inc(
+                    path="score_snapshot")
+                get_logger("tpu_backend").warning(
+                    "nonfinite_verdict_field", field=k)
+                break
+
         n = snapshot.num_incidents
         res = {
             "incident_ids": snapshot.incident_ids,

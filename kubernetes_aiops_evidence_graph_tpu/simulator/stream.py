@@ -168,6 +168,32 @@ def apply_event(cluster: FakeCluster, event: ChurnEvent) -> list[str]:
     return touched
 
 
+class _StoreOnly:
+    """stream_step scorer stand-in that drops every mirror call: drivers
+    that serve through a journal-draining path (scorer.sync(), serve(),
+    or the graft-shield's write-ahead staging) mutate ONLY the store and
+    let the scorer catch up from its change journal — the shield's
+    durability guarantee covers exactly what flows through that journal."""
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+_STORE_ONLY = _StoreOnly()
+
+
+def store_step(cluster: FakeCluster, store, event: ChurnEvent) -> list[str]:
+    """stream_step without the direct scorer mirroring: cluster + store
+    only. Feature mutations are journaled via ``store.touch_nodes`` (the
+    in-place property path bypasses upsert), so a journal-draining
+    consumer — scorer.sync(), serve(), the graft-shield WAL — sees every
+    change. The full-mix driver for journal-synced serving (graft-shield
+    fault-injection tests, recovery bench)."""
+    touched = stream_step(cluster, store, _STORE_ONLY, event)
+    store.touch_nodes(touched)
+    return touched
+
+
 def stream_step(cluster: FakeCluster, store, scorer, event: ChurnEvent) -> list[str]:
     """Apply ONE event everywhere: cluster state, graph store (authoritative
     — rebuilds read it), and the streaming scorer's incremental state.

@@ -13,6 +13,7 @@ to be idempotent (SURVEY.md §5 checkpoint/resume).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import inspect
 import json
 import time
@@ -32,17 +33,30 @@ class NonRetryableError(Exception):
 @dataclass(frozen=True)
 class RetryPolicy:
     """Reference defaults: 3 attempts, 1s → 5m exponential backoff
-    (incident_workflow.py:60-72)."""
+    (incident_workflow.py:60-72), plus deterministic seeded jitter."""
     max_attempts: int = 3
     initial_interval_s: float = 1.0
     backoff: float = 2.0
     max_interval_s: float = 300.0
+    # ± fraction of the backoff applied as jitter. Seeded from the caller
+    # key (workflow_id) + attempt, NOT from random(): a mass failure that
+    # fails N workflows at once must not wake all N in lockstep on every
+    # retry round (thundering herd), while a journal REPLAY of one
+    # workflow must sleep exactly what the original run slept — replay
+    # determinism is the Temporal-parity contract this engine keeps.
+    jitter: float = 0.1
+
     non_retryable: tuple[type[Exception], ...] = (ValueError, TypeError,
                                                   NonRetryableError)
 
-    def delay(self, attempt: int) -> float:
-        return min(self.initial_interval_s * self.backoff ** (attempt - 1),
+    def delay(self, attempt: int, key: "str | None" = None) -> float:
+        base = min(self.initial_interval_s * self.backoff ** (attempt - 1),
                    self.max_interval_s)
+        if not self.jitter or key is None:
+            return base
+        digest = hashlib.sha256(f"{key}:{attempt}".encode()).digest()
+        u = int.from_bytes(digest[:8], "big") / float(1 << 64)  # [0, 1)
+        return base * (1.0 - self.jitter + 2.0 * self.jitter * u)
 
 
 @dataclass
@@ -133,7 +147,8 @@ class WorkflowEngine:
                                         {"error": str(exc)}, attempts=attempts,
                                         duration_s=dt)
                     raise StepFailed(step.name, exc, attempts) from exc
-                await self._sleep(step.retry.delay(attempts))
+                await self._sleep(step.retry.delay(attempts,
+                                                   key=workflow_id))
 
     def status(self, workflow_id: str) -> dict:
         """Queryable in-flight state (reference @workflow.query, :40-53)."""

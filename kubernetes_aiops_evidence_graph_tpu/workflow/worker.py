@@ -65,24 +65,35 @@ class IncidentWorker:
             if self.scorer is None:
                 if self.settings.rca_backend == "gnn":
                     from ..rca.gnn_streaming import GnnStreamingScorer
-                    self.scorer = GnnStreamingScorer(
+                    scorer = GnnStreamingScorer(
                         self.builder.store, self.settings,
                         mesh=self._serving_mesh())
                 else:
                     from ..rca.streaming import StreamingScorer
-                    self.scorer = StreamingScorer(self.builder.store,
-                                                  self.settings,
-                                                  mesh=self._serving_mesh())
+                    scorer = StreamingScorer(self.builder.store,
+                                             self.settings,
+                                             mesh=self._serving_mesh())
                 # pre-compile the steady-state delta buckets AND the next
                 # bucket shapes off the serving path so neither hot ticks
                 # nor growth rebuilds pay an XLA compile mid-serve;
                 # auto_warm_growth re-arms after every shape change so the
                 # guarantee holds for successive growths too
-                self.scorer.auto_warm_growth = True
+                scorer.auto_warm_growth = True
                 self._warm_thread = threading.Thread(
-                    target=self.scorer.warm_serving,
+                    target=scorer.warm_serving,
                     name="kaeg-warm-serving", daemon=False)
                 self._warm_thread.start()
+                if self.settings.shield_enabled:
+                    # graft-shield: wrap the resident scorer in the
+                    # crash-consistent recovery layer, and on acquisition
+                    # either restore a compatible on-disk snapshot+journal
+                    # (a prior shield of THIS store lineage — e.g. a
+                    # restarted serve loop in the same process) or anchor a
+                    # fresh snapshot so recovery is possible from tick one
+                    from ..rca.shield import ShieldedScorer
+                    scorer = ShieldedScorer(scorer, self.settings)
+                    scorer.recover_or_snapshot()
+                self.scorer = scorer
             return self.scorer
 
     def _serving_mesh(self):

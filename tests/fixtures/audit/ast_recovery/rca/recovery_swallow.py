@@ -1,0 +1,22 @@
+"""Seeded violation: a degradation-ladder step that swallows everything.
+
+Must trip EXACTLY `recovery-no-broad-except` — a broad except inside a
+recovery-named function that neither re-raises nor escalates turns a
+non-transient fault into silent wrong-tier serving. The second function
+shows the sanctioned escalate pattern and must produce NO finding.
+"""
+
+
+def _recover_from_device_loss(scorer):
+    try:
+        return scorer.rescore()
+    except Exception:
+        return None        # silent give-up: the seeded violation
+
+
+def _degrade_with_escalation(shield, scorer):
+    try:
+        return scorer.rescore()
+    except Exception as exc:
+        shield.escalate(exc)   # sanctioned: the ladder decides, visibly
+        return None

@@ -102,6 +102,26 @@ def test_shipped_ticks_declare_their_mirror_state_donation():
             "streaming.gnn_tick.coalesced"} <= names
 
 
+def test_recovery_no_broad_except_fixture_trips_exactly_its_rule():
+    """graft-shield satellite: a broad except inside a recovery-named
+    function under a hot dir that neither re-raises nor escalates trips
+    exactly `recovery-no-broad-except` (replacing — not stacking on — the
+    generic broad-except in recovery context); the escalate-pattern
+    sibling in the same fixture produces no finding."""
+    report = lint_tree(FIXTURES / "ast_recovery")
+    got = {(f.where.rsplit(":", 1)[0], f.rule) for f in report.violations}
+    assert got == {("rca/recovery_swallow.py", "recovery-no-broad-except")}
+    assert len(report.violations) == 1   # the escalating handler is clean
+    assert not report.waivers
+    # CLI exits non-zero on the seeded tree
+    assert audit_main(["--root", str(FIXTURES / "ast_recovery")]) == 1
+    # and the shipped shield kernels are declared (completeness contract)
+    assert ("rca/shield.py", "_snapshot_pack") in JIT_DECLARATIONS
+    assert ("rca/shield.py", "_snapshot_unpack") in JIT_DECLARATIONS
+    names = {e.name for e in ENTRYPOINTS}
+    assert {"shield.snapshot_pack", "shield.snapshot_unpack"} <= names
+
+
 def test_ast_clean_tree_has_no_violations_and_counts_the_waiver():
     report = lint_tree(FIXTURES / "ast_clean")
     assert report.violations == []

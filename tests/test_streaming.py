@@ -307,6 +307,59 @@ def test_width_bucket_overflow_grows_and_stays_correct():
     assert mine == theirs
 
 
+def test_needs_rebuild_escalation_past_growth_ladder_rebuilds_cleanly(
+        monkeypatch):
+    """graft-shield satellite: width/pair growth past the LADDER TOP must
+    escalate through NeedsRebuild to a clean store-derived rebuild (never
+    mint an unplanned off-ladder compile in place), with verdict parity
+    before/after. The ladders are monkeypatched tiny so real evidence
+    counts overflow them."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import ids as gids
+    from kubernetes_aiops_evidence_graph_tpu.models import GraphRelation
+    from kubernetes_aiops_evidence_graph_tpu.rca import streaming as st
+
+    cluster, builder, incidents = _world()
+    store = builder.store
+    scorer = StreamingScorer(store, SMALL)
+    scorer.rescore()
+    # a ladder whose top is the CURRENT width: any growth escalates
+    monkeypatch.setattr(st, "_WIDTH_BUCKETS", (scorer.width,))
+    with pytest.raises(st.NeedsRebuild):
+        scorer._grow_width()
+    rebuilds0 = scorer.rebuilds
+
+    inc_nid = f"incident:{incidents[0].id}"
+    added = 0
+    for key in sorted(cluster.pods):
+        if added > scorer.width:
+            break
+        ns, name = key.split("/", 1)
+        pid = gids.pod_id(ns, name)
+        if store.get_node(pid) is None:
+            continue
+        if store.upsert_relations([GraphRelation(
+                source_id=inc_nid, target_id=pid,
+                relation_type="AFFECTS")]):
+            if scorer.add_evidence(inc_nid, pid):
+                added += 1
+    assert scorer.rebuilds > rebuilds0, \
+        "ladder exhaustion never escalated to a rebuild"
+
+    # clean rebuild: verdict parity against a from-scratch scorer over the
+    # same mutated store (the rebuild may land off-ladder, explicitly)
+    out = scorer.rescore()
+    fresh = StreamingScorer(store, SMALL)
+    ref = fresh.rescore()
+    mine = dict(zip(out["incident_ids"], np.asarray(out["top_rule_index"])))
+    theirs = dict(zip(ref["incident_ids"], np.asarray(ref["top_rule_index"])))
+    assert mine == theirs
+
+    # pair-width ladder escalates identically
+    monkeypatch.setattr(st, "_PAIR_WIDTH_BUCKETS", (scorer.pair_width,))
+    with pytest.raises(st.NeedsRebuild):
+        scorer._grow_pair_width()
+
+
 def test_pod_create_attaches_as_evidence():
     """A streamed pod creation with attach_to becomes live evidence: a
     crashlooping created pod flips its incident's verdict."""

@@ -50,7 +50,14 @@ def test_engine_retry_and_non_retryable():
 
     out = _run(engine.run("wf-retry", [Step("flaky", flaky, timeout_s=5)], ctx))
     assert out["flaky"] == {"ok": True} and attempts["n"] == 3
-    assert sleeps == [1.0, 2.0]  # exponential backoff
+    # exponential backoff with deterministic seeded jitter (keyed on
+    # workflow_id + attempt): exactly reproducible, within ±10% of base
+    from kubernetes_aiops_evidence_graph_tpu.workflow.engine import RetryPolicy
+    pol = RetryPolicy()
+    assert sleeps == [pol.delay(1, key="wf-retry"),
+                      pol.delay(2, key="wf-retry")]
+    for got, base in zip(sleeps, [1.0, 2.0]):
+        assert abs(got - base) <= pol.jitter * base
 
     def bad(c):
         raise ValueError("no retry")
@@ -275,3 +282,29 @@ def test_worker_warm_lifecycle_stops_and_resumes():
         assert completed == 2
     finally:
         db.close()
+
+
+def test_retry_policy_seeded_jitter_is_deterministic_and_bounded():
+    """Thundering-herd satellite: backoff jitter is seeded from
+    (key, attempt) — same workflow replays the same delays (journal-replay
+    determinism), distinct workflows de-synchronize, and the jitter stays
+    within ±`jitter` of the exponential base, capped at max_interval_s."""
+    from kubernetes_aiops_evidence_graph_tpu.workflow.engine import RetryPolicy
+
+    pol = RetryPolicy()
+    # replay determinism
+    assert pol.delay(1, key="wf-a") == pol.delay(1, key="wf-a")
+    assert pol.delay(2, key="wf-a") == pol.delay(2, key="wf-a")
+    # no key -> exact legacy base (back-compat callers)
+    assert pol.delay(1) == 1.0 and pol.delay(2) == 2.0
+    # herd de-synchronization: many keys spread, not collapse
+    delays = {pol.delay(1, key=f"wf-{i}") for i in range(50)}
+    assert len(delays) == 50
+    # bounds: ±jitter around base, at every attempt incl. the cap
+    for attempt, base in ((1, 1.0), (2, 2.0), (3, 4.0), (30, 300.0)):
+        for i in range(20):
+            d = pol.delay(attempt, key=f"wf-{i}")
+            assert abs(d - base) <= pol.jitter * base + 1e-12
+    # zero-jitter policy degrades to the exact exponential series
+    flat = RetryPolicy(jitter=0.0)
+    assert flat.delay(3, key="anything") == 4.0
