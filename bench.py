@@ -568,6 +568,190 @@ def bench_pipeline_sweep(num_pods: int = 1000, num_incidents: int = 30,
     }
 
 
+def _sharded_tick_census(scorer) -> dict:
+    """Modeled per-tick collective census of the EXACT tick the sharded
+    scorer dispatches at its live shapes: trace the tick's jaxpr and run
+    the graft-cost model over it (the same machinery the ratchet uses,
+    so the record's halo numbers cannot drift from the enforced ones)."""
+    import jax as _jax
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_jaxpr)
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        _DELTA_BUCKETS, _ROW_BUCKETS, _pack_ints_sharded)
+    g = scorer._graph_size()
+    pn = scorer.snapshot.padded_nodes
+    pi = scorer.snapshot.padded_incidents
+    dim = scorer.snapshot.features.shape[1]
+    pk, rk = _DELTA_BUCKETS[0], _ROW_BUCKETS[0]
+    width, pw = scorer.width, scorer.pair_width
+    tick = scorer._tick_fn(pn, pi, width, pw, pk=pk, rk=rk)
+    ints = _pack_ints_sharded(
+        np.full((g, pk), pn // g, np.int32),
+        np.full(rk, pi, np.int32), np.zeros(rk, np.int32),
+        np.zeros((rk, width), np.int32),
+        np.full((rk, width), pw, np.int32))
+    args = (np.zeros((pn, dim), np.float32), ints,
+            np.zeros((g, pk, dim), np.float32),
+            np.zeros((pi, width), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, width), pw, np.int32),
+            np.zeros(pi, np.float32))
+    cost = cost_jaxpr("streaming.rules_tick.sharded.live",
+                      _jax.make_jaxpr(tick)(*args))
+    # exact closed-form ceiling at the live shapes: the owner-fold's one
+    # verdict psum moves [rows, DIM + pair_width] f32 once per tick
+    ceiling = pi * (dim + pw) * 4
+    return {
+        "halo_bytes_per_tick_modeled": int(cost.collective_bytes),
+        "halo_collectives_per_tick": {
+            prim: rec["count"] for prim, rec in cost.collectives.items()},
+        "halo_bytes_vs_costspec_ceiling": round(
+            cost.collective_bytes / max(ceiling, 1), 4),
+    }
+
+
+def bench_streaming_sharded_sweep(num_pods: int = 1000,
+                                  num_incidents: int = 30,
+                                  events: int = 600, batch_size: int = 50,
+                                  seed: int = 0,
+                                  shard_counts=(1, 2, 4, 8),
+                                  verbose: bool = True) -> dict:
+    """graft-fleet: the mesh-resident streaming serving state at
+    D ∈ {1, 2, 4, 8} graph shards (settings.serve_graph_shards).
+
+    Each shard count replays the IDENTICAL seeded world + churn script on
+    a fresh scorer (pipeline depth 2 — the serving default rides the
+    sharded tick unchanged); the final caller-boundary rescore must be
+    BIT-identical across shard counts (raises on any divergence), so the
+    sweep doubles as the fleet-parity gate and emits on CPU exactly as on
+    TPU via the forced-host-device fallback (parallel/mesh). Per shard
+    count the record carries the per-tick halo traffic MODELED by the
+    graft-cost machinery over the live tick's jaxpr (the rules tick moves
+    one [rows, DIM+PW] verdict psum and zero node blocks) against the
+    closed-form CostSpec ceiling. Measured ICI bandwidth is unknowable
+    off-TPU and honest-nulled there (`measured_halo_bandwidth_gbs`)."""
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.parallel.mesh import (
+        ensure_host_devices)
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        StreamingScorer)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        SCENARIOS, generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, stream_step)
+
+    import jax
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose \
+        else (lambda *a: None)
+    want = tuple(shard_counts)
+    ensure_host_devices(max(want))
+    avail = len(jax.devices())
+    shard_counts = tuple(d for d in want if d <= avail)
+    skipped = [d for d in want if d > avail]
+    if skipped:
+        log(f"sharded sweep: skipping D={skipped} (only {avail} devices)")
+    per_shards: dict[int, dict] = {}
+    finals: dict[int, dict] = {}
+    for shards in shard_counts:
+        settings = load_settings(serve_graph_shards=shards)
+        cluster = generate_cluster(num_pods=num_pods, seed=seed)
+        rng = np.random.default_rng(seed)
+        builder = GraphBuilder()
+        sync_topology(cluster, builder.store)
+        keys = sorted(cluster.deployments)
+        names = sorted(SCENARIOS)
+        injected = []
+        for i in range(num_incidents):
+            inc = inject(cluster, names[i % len(names)],
+                         keys[(i * 7) % len(keys)], rng)
+            injected.append(inc)
+            builder.ingest(inc, collect_all(
+                inc, default_collectors(cluster, settings), parallel=False))
+        scorer = StreamingScorer(builder.store, settings,
+                                 now_s=cluster.now.timestamp())
+        if shards > 1 and not scorer._graph_sharded(
+                scorer.snapshot.padded_nodes,
+                scorer.snapshot.padded_incidents):
+            log(f"sharded sweep: D={shards} inapplicable at these buckets")
+            continue
+        scorer.rescore()   # warm compile + first fetch
+        stream = list(churn_events(
+            cluster, events, seed=seed + 1,
+            incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+        submit_times = []
+        t0 = time.perf_counter()
+        for s in range(0, len(stream), batch_size):
+            for ev in stream[s:s + batch_size]:
+                stream_step(cluster, builder.store, scorer, ev)
+            t1 = time.perf_counter()
+            scorer.tick_async()
+            submit_times.append(time.perf_counter() - t1)
+        final = scorer.rescore()   # ONE fetch for the whole run
+        wall = time.perf_counter() - t0
+        finals[shards] = final
+        halo = (_sharded_tick_census(scorer) if shards > 1 else {
+            "halo_bytes_per_tick_modeled": 0,
+            "halo_collectives_per_tick": {},
+            "halo_bytes_vs_costspec_ceiling": 0.0,
+        })
+        per_shards[shards] = {
+            "wall_s": round(wall, 4),
+            "events_per_sec": round(len(stream) / wall, 1),
+            "submit_p50_ms": round(
+                statistics.median(submit_times) * 1e3, 3),
+            "dispatch_ms": round(final["dispatch_seconds"] * 1e3, 3),
+            "fetch_ms": round(final["fetch_seconds"] * 1e3, 3),
+            "rebuilds": scorer.rebuilds,
+            **halo,
+        }
+        log(f"graph shards {shards}: "
+            f"{per_shards[shards]['events_per_sec']} ev/s, "
+            f"halo {halo['halo_bytes_per_tick_modeled']} B/tick")
+
+    # fleet parity IS the correctness bar: bit-identical result arrays at
+    # the caller boundary for every shard count (fresh seeded world per
+    # D — row order deterministic, uuids per-run, so compare arrays)
+    base_d = shard_counts[0]
+    base = finals[base_d]
+    for shards in shard_counts[1:]:
+        if shards not in finals:
+            continue
+        f = finals[shards]
+        if len(f["incident_ids"]) != len(base["incident_ids"]):
+            raise SystemExit(
+                f"FLEET PARITY MISMATCH at D={shards}: live-incident "
+                f"count {len(f['incident_ids'])} != "
+                f"{len(base['incident_ids'])}")
+        for key in ("conditions", "matched", "scores", "top_rule_index",
+                    "any_match", "top_confidence", "top_score"):
+            if not np.array_equal(np.asarray(f[key]), np.asarray(base[key])):
+                raise SystemExit(
+                    f"FLEET PARITY MISMATCH at D={shards}: {key}")
+
+    top = max(per_shards)
+    return {
+        "metric": "streaming_sharded_sweep",
+        "value": per_shards[top]["events_per_sec"],
+        "unit": f"events/s at D={top} (bit-parity gated)",
+        "vs_baseline": round(
+            per_shards[top]["events_per_sec"]
+            / max(per_shards[base_d]["events_per_sec"], 1e-9), 3),
+        "parity": "bit_identical",
+        "shards": {str(d): per_shards[d] for d in per_shards},
+        "skipped_shard_counts": skipped,
+        # real-TPU-only measurement, deferred to a real multi-chip run:
+        # honest-nulled everywhere until then (virtual CPU devices share
+        # one memory bus — an 'ICI bandwidth' there would lie)
+        "measured_halo_bandwidth_gbs": None,
+        "platform": jax.default_backend(),
+    }
+
+
 def bench_recovery(num_pods: int = 35000, num_incidents: int = 100,
                    events: int = 2000, batch: int = 100, seed: int = 0,
                    mttr_cycles: int = 3, snapshot_every: int = 512,
@@ -925,6 +1109,15 @@ def run_config(cfg: int, args) -> dict:
         except (Exception, SystemExit) as exc:
             print(json.dumps({
                 "metric": "streaming_pipeline_depth_sweep",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # graft-fleet shard sweep (D up to what the device pool carries;
+        # parity asserted, halo bytes modeled, TPU fields honest-nulled)
+        try:
+            print(json.dumps(bench_streaming_sharded_sweep()), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "streaming_sharded_sweep",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
         # graft-shield recovery economics at the 50k-graph-node config:

@@ -71,6 +71,8 @@ TRACED_EXTRA = {
     "scatter_max", "scatter_add_2d", "gather_neighbors", "_aggregate",
     "finish_scores", "pair_contract", "_ring_messages", "_ring_readout",
     "local_loss", "local_score", "local_tick",
+    "evidence_fold_block", "local_rules_tick", "local_gnn_tick",
+    "_assemble_ring", "_readout_ring",
 }
 
 # calls that produce device values (for the host-sync dataflow)
@@ -113,7 +115,11 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
     ("rca/streaming.py", "_tick"): (
         ("padded_incidents", "pair_width", "pk", "rk", "width"),
         (0, 3, 4, 5)),
-    ("rca/streaming.py", "tick"): ((), (0, 3, 4, 5)),
+    # graft-fleet mesh-resident ticks (parallel/sharded_streaming.py):
+    # same donation contract as their single-device counterparts — the
+    # sharded resident mirror flows through, never reallocates
+    ("parallel/sharded_streaming.py", "rules_tick"): ((), (0, 3, 4, 5)),
+    ("parallel/sharded_streaming.py", "gnn_tick"): ((), (2, 3, 4, 5, 6, 7)),
     ("rca/tpu_backend.py", "_score_device"): (
         ("padded_incidents", "pair_width"), ()),
     ("rca/device_metrics.py", "_scan_stream"): (("k",), ()),

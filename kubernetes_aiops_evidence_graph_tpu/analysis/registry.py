@@ -259,6 +259,69 @@ def _gnn_tick_coalesced_build():
     return _gnn_tick_build(pk=_DELTA_BUCKETS[-1], ek=_DELTA_BUCKETS[-1])
 
 
+def _sharded_rules_tick_build():
+    """graft-fleet: the mesh-resident rules tick at the canonical
+    streaming shapes on a (1 x GRAPH_SHARDS) serving mesh — per-shard
+    routed feature deltas, owner-local evidence fold, ONE verdict psum."""
+    from ..parallel.mesh import serving_mesh
+    mesh = serving_mesh(GRAPH_SHARDS)
+    if mesh is None:
+        raise SkipEntrypoint(
+            f"needs >= {GRAPH_SHARDS} devices for the graph axis")
+    np = _np()
+    from ..graph.schema import DIM
+    from ..parallel.sharded_streaming import sharded_rules_tick
+    g = GRAPH_SHARDS
+    pn, pi, width, pair_width = 4096, 32, 128, 16
+    pk, rk = 64, 4
+    fn = sharded_rules_tick(mesh, pn // g, pi, pair_width, pk, rk, width)
+    ints = np.zeros((g, pk + 2 * rk + 2 * rk * width), np.int32)
+    args = (np.zeros((pn, DIM), np.float32), ints,
+            np.zeros((g, pk, DIM), np.float32),
+            np.zeros((pi, width), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, width), pair_width, np.int32),
+            np.zeros(pi, np.float32))
+    return fn, args
+
+
+# per-shard relation-slice capacities the sharded GNN streaming tick
+# traces with: the canonical REL_COUNTS split over the graph axis (edges
+# partition by dst owner), floored so every relation keeps a live slice
+STREAM_SHARD_REL_COUNTS = tuple(
+    max(c // GRAPH_SHARDS, 64) for c in REL_COUNTS)
+
+
+def _sharded_gnn_tick_build():
+    """graft-fleet: the mesh-resident GNN streaming tick — per-shard edge
+    regions, ring-halo message pass ((LAYERS+1)*GRAPH_SHARDS ppermutes of
+    [N/D, H] blocks, zero all-gathers), ring readout."""
+    from ..parallel.mesh import serving_mesh
+    mesh = serving_mesh(GRAPH_SHARDS)
+    if mesh is None:
+        raise SkipEntrypoint(
+            f"needs >= {GRAPH_SHARDS} devices for the graph axis")
+    np = _np()
+    from ..graph.schema import DIM
+    from ..graph.snapshot import rel_slice_offsets
+    from ..parallel.sharded_streaming import sharded_gnn_tick
+    g = GRAPH_SHARDS
+    pn, pi = 4096, 32
+    offs = rel_slice_offsets(STREAM_SHARD_REL_COUNTS)
+    pe_shard = int(offs[-1])
+    pe = pe_shard * g
+    pk = ek = 64
+    # the sharded mirror never promises slices_sorted under churn
+    fn = sharded_gnn_tick(mesh, pn // g, pe_shard, pi, pk, ek,
+                          rel_offsets=offs, slices_sorted=False,
+                          compute_dtype=None)
+    ints = np.zeros((g, 3 * pk + 5 * ek + 2 * pi), np.int32)
+    args = (_params(), np.zeros((pn, DIM), np.float32),
+            np.zeros(pn, np.int32), np.ones(pn, np.float32),
+            np.zeros(pe, np.int32), np.zeros(pe, np.int32),
+            np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
+    return fn, args
+
+
 def _gms_build(compute_dtype=None):
     def build():
         np = _np()
@@ -416,6 +479,28 @@ _RING_COST = CostSpec(
     max_bytes_per_op={"ppermute": _NPS * HIDDEN * 4},
     max_total_bytes=(LAYERS + 1) * GRAPH_SHARDS * _NPS * HIDDEN * 4 + 1024,
 )
+# graft-fleet streaming ticks (canonical shapes: pn=4096, pi=32 rows,
+# DIM=48 features, pair_width=16). Rules: the owner-fold needs ONE psum
+# of the concatenated [rows, DIM+PW] counts — zero ppermutes, zero
+# all-gathers (the fold moves per-row counts, never node blocks). GNN:
+# exactly (LAYERS+1)*D ppermutes of one [N/D, H] embedding block each
+# (LAYERS assembly rings + the readout ring) and nothing else — the
+# same contract the snapshot ring kernels already obey.
+_STREAM_NPS = 4096 // GRAPH_SHARDS
+_SHARDED_RULES_TICK_COST = CostSpec(
+    expect_counts={"psum": 1, "ppermute": 0, "all_gather": 0},
+    forbid=("all_to_all", "reduce_scatter", "psum_scatter", "pshuffle"),
+    max_bytes_per_op={"psum": 32 * (48 + 16) * 4},
+    max_total_bytes=32 * (48 + 16) * 4 + 1024,
+)
+_SHARDED_GNN_TICK_COST = CostSpec(
+    expect_counts={"ppermute": (LAYERS + 1) * GRAPH_SHARDS, "psum": 0,
+                   "all_gather": 0},
+    forbid=("all_to_all", "reduce_scatter", "psum_scatter", "pshuffle"),
+    max_bytes_per_op={"ppermute": _STREAM_NPS * HIDDEN * 4},
+    max_total_bytes=(LAYERS + 1) * GRAPH_SHARDS * _STREAM_NPS * HIDDEN * 4
+    + 1024,
+)
 
 
 ENTRYPOINTS: tuple[Entrypoint, ...] = (
@@ -478,6 +563,22 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "top); explicit zero-collective CostSpec — the serving tick "
               "may never go distributed implicitly",
         cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.rules_tick.sharded", _sharded_rules_tick_build, _TICK,
+        notes="graft-fleet mesh-resident rules tick: per-shard routed "
+              "deltas, owner-local evidence fold, verdicts reduced with "
+              "ONE [rows, DIM+PW] psum — zero ppermutes, zero "
+              "all-gathers; the ratchet pins halo traffic from day one",
+        cost=_SHARDED_RULES_TICK_COST),
+    Entrypoint(
+        "streaming.gnn_tick.sharded", _sharded_gnn_tick_build, _TICK,
+        notes="graft-fleet mesh-resident GNN tick: per-shard edge "
+              "regions, ring-halo message pass — exactly "
+              "(LAYERS+1)*GRAPH_SHARDS ppermutes of [N/D, H] blocks "
+              "(LAYERS assembly rings + the readout ring), ZERO [N, H] "
+              "all-gathers, zero psums; same contract as the snapshot "
+              "ring kernels",
+        cost=_SHARDED_GNN_TICK_COST),
     Entrypoint("ops.gather_matmul_segment", _gms_build(), _HOT),
     Entrypoint(
         "ops.gather_matmul_segment.bf16", _gms_build("bfloat16"),

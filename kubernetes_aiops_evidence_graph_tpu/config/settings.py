@@ -109,6 +109,24 @@ class Settings:
     # _DELTA_BUCKETS retrace ladder) instead of blocking or queueing
     # unboundedly. Results are bit-identical at every depth.
     serve_pipeline_depth: int = 2
+    # graft-fleet (parallel/sharded_streaming.py): shard the RESIDENT
+    # streaming serving state over a ``graph`` mesh axis of this many
+    # devices. 1 (default) = exact current single-device behavior. > 1:
+    # the scorer builds a (1 x D) mesh, node/feature/evidence tables and
+    # the GNN edge mirror split into D contiguous graph partitions, the
+    # host delta-packing stage routes each delta batch to its owner shard
+    # (per-shard _DELTA_BUCKETS sub-buckets), and each tick runs the
+    # ring-halo message pass — exactly (LAYERS+1)*D ppermutes of
+    # [N/D, H] blocks, zero [N, H] all-gathers (CostSpec-pinned in
+    # analysis/registry.py). On CPU hosts the virtual-device fallback
+    # (parallel/mesh.ensure_host_devices) makes this testable hermetically.
+    serve_graph_shards: int = 1
+    # workflow verdict fetch narrowing (tpu_backend.score_snapshot): "top"
+    # (default) fetches only the per-incident verdict fields — the wide
+    # [Pi, C]/[Pi, R] conditions/matched/scores tables never leave the
+    # device on the snapshot-scoring verdict path. "full" restores the
+    # wide fetch (every matched rule becomes a ranked Hypothesis).
+    workflow_verdict_fields: str = "top"
     # graft-shield (rca/shield.py): crash-consistent recovery + graceful
     # degradation over the donated serving state. When enabled, the
     # workflow worker wraps the resident scorer in a ShieldedScorer: every

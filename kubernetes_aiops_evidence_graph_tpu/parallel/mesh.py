@@ -15,9 +15,65 @@ the same code runs on a v5e pod slice or an 8-device virtual CPU mesh.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_FORCE_FLAG = "xla_force_host_platform_device_count"
+
+
+def _backend_initialized() -> bool:
+    """True once jax has committed to a backend (after which the forced
+    host-device count can no longer change for this process)."""
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:  # graft-audit: allow[broad-except] private-API probe: assume initialized when unsure
+        return True
+
+
+def ensure_host_devices(n: int) -> bool:
+    """Honor the ``XLA_FLAGS=--xla_force_host_platform_device_count``
+    fallback: make sure at least ``n`` devices exist, forcing virtual CPU
+    host devices when the backend is not yet initialized. Returns True
+    when ``n`` devices are (or will be) available — the sharded streaming
+    paths and their registry entrypoints call this instead of raising
+    ``SkipEntrypoint``/skipping outright, so CPU hosts exercise the mesh
+    code hermetically (tests/conftest.py pre-forces 8; this covers bare
+    scripts and the analysis CLI too)."""
+    if n <= 1:
+        return True
+    if _backend_initialized():
+        return len(jax.devices()) >= n
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _FORCE_FLAG in flags:
+        # a count is already requested; honor it rather than fight it
+        try:
+            want = int(flags.split(f"--{_FORCE_FLAG}=", 1)[1].split()[0])
+        except (IndexError, ValueError):
+            return len(jax.devices()) >= n
+        return want >= n or len(jax.devices()) >= n
+    os.environ["XLA_FLAGS"] = (flags + f" --{_FORCE_FLAG}={n}").strip()
+    return True
+
+
+def serving_mesh(graph: int, devices=None) -> "Mesh | None":
+    """(1 x graph) serving mesh for the graph-sharded streaming scorer
+    (settings.serve_graph_shards). None when the device pool cannot carry
+    the axis — callers fall back to single-device serving (logged by the
+    scorer, never silent)."""
+    if graph <= 1:
+        return None
+    if devices is None:
+        if not ensure_host_devices(graph):
+            return None
+        devices = jax.devices()
+    if len(devices) < graph:
+        return None
+    arr = np.asarray(devices[:graph]).reshape(1, graph)
+    return Mesh(arr, axis_names=("dp", "graph"))
 
 
 def make_mesh(dp: int | None = None, graph: int | None = None,

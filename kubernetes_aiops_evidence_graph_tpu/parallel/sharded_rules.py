@@ -109,6 +109,54 @@ def device_put_sharded_batch(sb: ShardedBatch, mesh: Mesh) -> tuple:
 from .sharded_gnn import _ring_perm  # noqa: E402 — shared ring permutation
 
 
+def evidence_fold_block(h_blk, ev_idx, ev_cnt, ev_pair_slot, lo, *,
+                        nodes_per_shard: int, pair_width: int,
+                        rows_per_shard: int):
+    """Chunked fold of the evidence slots whose GLOBAL node id lives in
+    ``[lo, lo + nodes_per_shard)`` of node block ``h_blk``: bounds the
+    [rows, chunk, DIM] intermediate exactly like the single-device
+    _aggregate; the pair one-hot contraction rides the same in-block
+    gathered rows. Out-of-block slots contribute exact zeros, so folding
+    every block once — in any grouping — reproduces the single-device
+    fold bit-exactly. Shared by the batch ring fold below and the
+    owner-fold of the graph-sharded streaming tick
+    (parallel/sharded_streaming.py)."""
+    from ..graph.schema import F
+    from ..rca.tpu_backend import _FOLD_CHUNK, pair_contract
+
+    slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx.shape, 1)
+                 < ev_cnt[:, None]).astype(h_blk.dtype)       # [rows, W]
+    width = ev_idx.shape[1]
+
+    def fold_slice(idx, pslot, live):
+        in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
+                  ).astype(h_blk.dtype) * live
+        local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
+        rows = h_blk[local] * in_blk[:, :, None]
+        return (rows.sum(axis=1),
+                pair_contract(rows[:, :, F.POD_PROBLEM], pslot,
+                              pair_width))
+
+    if width <= _FOLD_CHUNK:
+        return fold_slice(ev_idx, ev_pair_slot, slot_live)
+
+    def chunk_body(acc, i):
+        sl_i = jax.lax.dynamic_slice_in_dim(
+            ev_idx, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+        sl_p = jax.lax.dynamic_slice_in_dim(
+            ev_pair_slot, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+        sl_m = jax.lax.dynamic_slice_in_dim(
+            slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
+        c, pc = fold_slice(sl_i, sl_p, sl_m)
+        return (acc[0] + c, acc[1] + pc), None
+    (c, pc), _ = jax.lax.scan(
+        chunk_body,
+        (jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
+         jnp.zeros((rows_per_shard, pair_width), jnp.float32)),
+        jnp.arange(width // _FOLD_CHUNK))
+    return c, pc
+
+
 def ring_fold(blk, ev_idx, ev_cnt, ev_pair_slot, *, nodes_per_shard: int,
               g_size: int, pair_width: int, rows_per_shard: int):
     """Ring evidence fold over 'graph'-sharded node features.
@@ -116,51 +164,21 @@ def ring_fold(blk, ev_idx, ev_cnt, ev_pair_slot, *, nodes_per_shard: int,
     Must run inside a shard_map whose mesh has a ``graph`` axis. ``blk`` is
     this shard's [Pn/G, DIM] node block; the evidence tables are this
     shard's local [rows, W] views. Each of the G steps folds the slots
-    whose GLOBAL node id lives in the currently-held block, then rotates
-    the block one hop (ppermute — the ring-attention pattern of
-    sharded_gnn). Returns ([rows, DIM] counts, [rows, pair_width]
-    pair_counts): complete after all G rotations. Shared by the batch
-    graph-sharded pass (make_graph_sharded_score) and the streaming
-    graph-sharded tick (rca/streaming.py)."""
-    from ..graph.schema import F
-    from ..rca.tpu_backend import _FOLD_CHUNK, pair_contract
-
-    my = jax.lax.axis_index("graph")
-    slot_live = (jax.lax.broadcasted_iota(jnp.int32, ev_idx.shape, 1)
-                 < ev_cnt[:, None]).astype(blk.dtype)         # [rows, W]
-    width = ev_idx.shape[1]
+    whose GLOBAL node id lives in the currently-held block
+    (evidence_fold_block), then rotates the block one hop (ppermute — the
+    ring-attention pattern of sharded_gnn). Returns ([rows, DIM] counts,
+    [rows, pair_width] pair_counts): complete after all G rotations. Used
+    by the batch graph-sharded pass (make_graph_sharded_score); the
+    streaming tick uses the cheaper owner-fold + psum
+    (parallel/sharded_streaming.py)."""
 
     def _fold_block(h_blk, lo):
-        """Chunked fold of slots whose node id lives in [lo, lo+nps):
-        bounds the [rows, chunk, DIM] intermediate exactly like the
-        single-device _aggregate; the pair one-hot contraction rides the
-        same in-block gathered rows."""
-        def fold_slice(idx, pslot, live):
-            in_blk = ((idx >= lo) & (idx < lo + nodes_per_shard)
-                      ).astype(h_blk.dtype) * live
-            local = jnp.clip(idx - lo, 0, nodes_per_shard - 1)
-            rows = h_blk[local] * in_blk[:, :, None]
-            return (rows.sum(axis=1),
-                    pair_contract(rows[:, :, F.POD_PROBLEM], pslot,
-                                  pair_width))
+        return evidence_fold_block(
+            h_blk, ev_idx, ev_cnt, ev_pair_slot, lo,
+            nodes_per_shard=nodes_per_shard, pair_width=pair_width,
+            rows_per_shard=rows_per_shard)
 
-        if width <= _FOLD_CHUNK:
-            return fold_slice(ev_idx, ev_pair_slot, slot_live)
-        def chunk_body(acc, i):
-            sl_i = jax.lax.dynamic_slice_in_dim(
-                ev_idx, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-            sl_p = jax.lax.dynamic_slice_in_dim(
-                ev_pair_slot, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-            sl_m = jax.lax.dynamic_slice_in_dim(
-                slot_live, i * _FOLD_CHUNK, _FOLD_CHUNK, axis=1)
-            c, pc = fold_slice(sl_i, sl_p, sl_m)
-            return (acc[0] + c, acc[1] + pc), None
-        (c, pc), _ = jax.lax.scan(
-            chunk_body,
-            (jnp.zeros((rows_per_shard, h_blk.shape[1]), jnp.float32),
-             jnp.zeros((rows_per_shard, pair_width), jnp.float32)),
-            jnp.arange(width // _FOLD_CHUNK))
-        return c, pc
+    my = jax.lax.axis_index("graph")
 
     def body(r, carry):
         h_blk, counts, pair_counts = carry

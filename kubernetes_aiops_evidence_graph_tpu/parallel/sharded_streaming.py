@@ -1,0 +1,343 @@
+"""graft-fleet — the RESIDENT streaming serving state sharded over the mesh.
+
+The single-device serving pass sits at 91% of its bandwidth roofline
+(BENCH_r05): the remaining scaling axis is OUT, not up. One
+``StreamingScorer``/``GnnStreamingScorer`` holds one donated resident
+mirror on one chip, capping the servable fleet at a single device's HBM.
+This module extends the donated tick state across a ``graph`` mesh axis
+of D devices (``settings.serve_graph_shards``) so one v5e-8 slice serves
+a 500k-pod fleet from a single resident sharded state:
+
+* **State layout.** Node-addressed tables (features, kind, nmask) keep
+  their GLOBAL shapes and shard into D contiguous node blocks via
+  ``NamedSharding(mesh, P("graph"))`` — the same owner assignment as the
+  batch partitioner (parallel/partition.py: owner = row // (Pn/D)). The
+  GNN edge mirror becomes D per-shard relation-bucketed regions stacked
+  in one [D·Pe_shard] slot space (owner shard = slot // Pe_shard; edges
+  live on their DESTINATION's owner, so the message scatter is always
+  shard-local). Evidence tables stay ``P("dp")`` (replicated across the
+  graph axis on the (1 x D) serving mesh).
+
+* **Delta routing.** The host delta-packing stage routes each delta
+  batch to its owner shard with PER-SHARD ``_DELTA_BUCKETS`` sub-buckets
+  (``route_node_delta``): the compiled delta width is the max over
+  shards, so one hot shard doesn't retrace the others, and within each
+  shard deltas keep store-journal order (the insertion order of the
+  pending dict / pending-edge map) — replay determinism is a routing
+  invariant, tested by the sort-contract test.
+
+* **Ticks.** ``sharded_rules_tick`` scatters locally, folds ONLY the
+  slots whose node lives in its own block (the shared
+  evidence_fold_block), and reduces verdicts with ONE small psum of the
+  concatenated [rows, DIM + pair_width] counts — strictly less traffic
+  than a ring of D ppermutes of [Pn/D, DIM] blocks, and bit-identical to
+  the single-device fold (out-of-block slots contribute exact zeros;
+  adding zeros never rounds). ``sharded_gnn_tick`` scatters its per-shard
+  deltas locally, then runs the ring-halo message pass: each layer
+  ASSEMBLES the [Pe_shard, H] source rows over D ``ppermute`` hops of the
+  [Pn/D, H] embedding block (each slot's row arrives from exactly one
+  block; the masked adds are exact), then runs the SAME fused
+  gather→matmul→segment kernel the single-device tick runs, shard-local.
+  The readout streams incident embeddings out of the ring (one more set
+  of D hops) — exactly ``(LAYERS+1)·D`` ppermutes of [N/D, H] blocks per
+  tick and ZERO [N, H] all-gathers, the same contract the snapshot
+  kernels already obey (CostSpec-pinned: analysis/registry.py
+  ``streaming.gnn_tick.sharded``).
+
+* **Parity.** The rules tick is BIT-identical to the single-device
+  scorer at every shard count and pipeline depth
+  (tests/test_sharded_streaming.py). The GNN tick is bit-identical
+  across pipeline depths and across crash/recovery at a fixed D — the
+  per-shard mirror layout is a pure function of the store journal — and
+  verdict-identical to D=1 with probs at float tolerance (the per-shard
+  slot allocation orders per-dst message sums differently; same contract
+  as the sharded snapshot kernels, parallel/sharded_gnn.py docstring).
+
+* **Donation.** Both ticks donate their resident arrays exactly like the
+  single-device ticks (`tick-donation` audit rule): the sharded mirror
+  is scattered in place per shard, never reallocated.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .compat import shard_map
+from .sharded_gnn import _ring_perm
+from .sharded_rules import evidence_fold_block
+
+
+def owner_of(rows, nodes_per_shard: int):
+    """Owner shard of each global node row — the contiguous-block
+    assignment of parallel/partition.py."""
+    return np.asarray(rows, np.int64) // int(nodes_per_shard)
+
+
+def route_node_delta(entries, nodes_per_shard: int, shards: int,
+                     buckets: tuple[int, ...]):
+    """Route host-side node deltas to their owner shards with per-shard
+    sub-buckets.
+
+    ``entries`` is an iterable of ``(global_row, payload...)`` tuples in
+    STORE-JOURNAL order. Returns ``(idx, payload_lists, pk)`` where
+    ``idx`` is [D, pk] of SHARD-LOCAL rows (padding = the out-of-range
+    sentinel ``nodes_per_shard``, dropped by the on-device scatter),
+    ``payload_lists`` is a list of per-shard payload lists aligned with
+    the live prefix of each shard's row, and ``pk`` is the shared static
+    sub-bucket width — ``bucket_for`` of the MAX per-shard count, so one
+    hot shard doesn't retrace the others. Within each shard the journal
+    order is preserved verbatim (the sort-contract invariant: replay
+    determinism depends on it)."""
+    from ..utils.padding import bucket_for
+    per_shard: list[list] = [[] for _ in range(shards)]
+    for e in entries:
+        g = int(e[0]) // nodes_per_shard
+        per_shard[g].append(e)
+    k = max((len(s) for s in per_shard), default=0)
+    pk = bucket_for(max(k, 1), buckets)
+    idx = np.full((shards, pk), nodes_per_shard, np.int32)
+    for g, ents in enumerate(per_shard):
+        for j, e in enumerate(ents):
+            idx[g, j] = int(e[0]) - g * nodes_per_shard
+    return idx, per_shard, pk
+
+
+@lru_cache(maxsize=None)
+def sharded_rules_tick(mesh, nodes_per_shard: int, rows_per_shard: int,
+                       pair_width: int, pk: int, rk: int, width: int):
+    """Graph-sharded fused rules tick (replaces the ring `_graph_tick`).
+
+    Per-shard packed delta layout (one [D, L] int32 transfer, in_spec
+    P("graph") — the row-delta payload rides duplicated in every shard's
+    row, its entries are [rk]-scale and the duplication is what keeps the
+    tick at two host→device transfers):
+
+      ints[g] = [ f_idx pk (SHARD-LOCAL, sentinel=nps) |
+                  r_idx rk | r_cnt rk | r_ev rk·W | r_pair rk·W ]
+
+    Each shard scatters its own feature-delta rows, scatters the
+    (dp-local) evidence-row delta, folds ONLY the slots whose node lives
+    in its own block, and ONE psum of the concatenated
+    [rows, DIM + pair_width] counts completes the fold — the
+    owner-fold + verdict-psum layout: zero ppermutes, zero all-gathers,
+    bit-identical to the single-device fold (out-of-block slots fold
+    exact zeros)."""
+    from ..rca.tpu_backend import finish_scores
+
+    def local_rules_tick(features, ints, f_rows, ev_idx, ev_cnt, ev_pair,
+                         chain):
+        ints, f_rows = ints[0], f_rows[0]    # [1, ...] graph-shard block
+        f_idx = ints[:pk]                    # already shard-local
+        r_idx = ints[pk:pk + rk]
+        r_cnt = ints[pk + rk:pk + 2 * rk]
+        off = pk + 2 * rk
+        r_ev = ints[off:off + rk * width].reshape(rk, width)
+        r_pair = ints[off + rk * width:
+                      off + 2 * rk * width].reshape(rk, width)
+
+        features = features.at[f_idx].set(f_rows, mode="drop")
+
+        lo_r = jax.lax.axis_index("dp") * rows_per_shard
+        rl = jnp.where((r_idx >= lo_r) & (r_idx < lo_r + rows_per_shard),
+                       r_idx - lo_r, rows_per_shard)
+        ev_idx = ev_idx.at[rl].set(r_ev, mode="drop")
+        ev_cnt = ev_cnt.at[rl].set(r_cnt, mode="drop")
+        ev_pair = ev_pair.at[rl].set(r_pair, mode="drop")
+
+        lo_n = jax.lax.axis_index("graph") * nodes_per_shard
+        counts, pair_counts = evidence_fold_block(
+            features, ev_idx, ev_cnt, ev_pair, lo_n,
+            nodes_per_shard=nodes_per_shard, pair_width=pair_width,
+            rows_per_shard=rows_per_shard)
+        # ONE small collective completes the fold: [rows, DIM+PW] psum
+        # over the graph axis (vs D ppermutes of [Pn/D, DIM] blocks in
+        # the ring formulation — the evidence fold needs every block's
+        # contribution, not the blocks themselves)
+        folded = jax.lax.psum(
+            jnp.concatenate([counts, pair_counts], axis=1), "graph")
+        counts = folded[:, :counts.shape[1]]
+        pair_counts = folded[:, counts.shape[1]:]
+        counts = counts + jnp.minimum(chain, 0.0)[:, None]
+        return (features, ev_idx, ev_cnt, ev_pair) + finish_scores(
+            counts, pair_counts.max(axis=1), rows_per_shard)
+
+    g, d = P("graph"), P("dp")
+    rules_tick = shard_map(
+        local_rules_tick, mesh=mesh,
+        in_specs=(g, g, g, d, d, d, d),
+        out_specs=(g, d, d, d) + (d,) * 7,
+        check_vma=False,
+    )
+    # same donation contract as the single-device _tick: the resident
+    # state flows through, so the sharded tick must not reallocate it
+    return jax.jit(rules_tick, donate_argnums=(0, 3, 4, 5))
+
+
+@lru_cache(maxsize=None)
+def sharded_gnn_tick(mesh, nodes_per_shard: int, pe_shard: int, pi: int,
+                     pk: int, ek: int, rel_offsets=None,
+                     slices_sorted: bool = False, compute_dtype=None):
+    """Graph-sharded fused GNN streaming tick: the mesh-resident analog of
+    rca/gnn_streaming._gnn_tick.
+
+    Resident per-shard state (all donated except params/features): the
+    aux tables kind/nmask shard with the features ([Pn] P("graph") node
+    blocks); the edge mirror is D per-shard relation-bucketed regions
+    stacked in one [D·Pe_shard] slot space (P("graph"): shard g owns
+    slots [g·Pe_shard, (g+1)·Pe_shard)) holding GLOBAL src ids and LOCAL
+    dst rows — every edge lives on its destination's owner, so the
+    segment-sum is always shard-local.
+
+    Per-shard packed delta ([D, L] int32, one transfer; incident tables
+    ride replicated in every shard's row — they are [Pi]-scale):
+
+      ints[g] = [ f_idx pk (local, sentinel=nps) | kind_v pk | nmask_v pk |
+                  e_idx ek (local slot, sentinel=Pe_shard) | e_src ek |
+                  e_dst ek (local) | e_rel ek | e_mask ek |
+                  inc_nodes pi (global) | inc_mask pi ]
+
+    Each tick: local delta scatters, then the ring-halo message pass —
+    per layer, the [Pe_shard, H] source rows are ASSEMBLED over D
+    ``ppermute`` hops of the [Pn/D, H] embedding block (each slot's row
+    arrives from exactly ONE block; the masked adds are exact, so the
+    assembled rows are bit-identical to a global gather), and the SAME
+    fused gather→matmul→segment kernel as the single-device tick runs
+    shard-local. The readout streams incident embeddings out of the ring:
+    exactly (LAYERS+1)·D ppermutes of [N/D, H] blocks per tick, zero
+    [N, H] all-gathers, zero psums (CostSpec-pinned)."""
+    from ..ops.segment import gather_matmul_segment
+    from ..rca import gnn
+
+    g_size = mesh.shape["graph"]
+
+    def _assemble_ring(h_local, esrc):
+        """[Pe_shard, H] source rows for this shard's edges, assembled
+        over one full rotation of the embedding blocks. Padded slots
+        (esrc=0, mask 0) assemble block 0's row and are zeroed by the
+        kernel's mask."""
+        my = jax.lax.axis_index("graph")
+
+        def body(r, carry):
+            h_block, rows = carry
+            src_shard = jnp.mod(my - r, g_size)
+            lo = src_shard * nodes_per_shard
+            in_blk = ((esrc >= lo) & (esrc < lo + nodes_per_shard)
+                      ).astype(h_block.dtype)
+            local = jnp.clip(esrc - lo, 0, nodes_per_shard - 1)
+            rows = rows + h_block[local] * in_blk[:, None]
+            h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(g_size))
+            return h_block, rows
+
+        _, rows = jax.lax.fori_loop(
+            0, g_size, body,
+            (h_local, jnp.zeros((pe_shard, h_local.shape[1]),
+                                h_local.dtype)))
+        return rows
+
+    def _readout_ring(h_local, inc_nodes):
+        """Stream incident-node embeddings out of the ring — the
+        (LAYERS+1)'th set of D hops; complete (and identical) on every
+        shard after the rotation."""
+        my = jax.lax.axis_index("graph")
+
+        def body(r, carry):
+            h_block, emb = carry
+            src_shard = jnp.mod(my - r, g_size)
+            lo = src_shard * nodes_per_shard
+            in_blk = ((inc_nodes >= lo)
+                      & (inc_nodes < lo + nodes_per_shard)
+                      ).astype(h_block.dtype)
+            local = jnp.clip(inc_nodes - lo, 0, nodes_per_shard - 1)
+            emb = emb + h_block[local] * in_blk[:, None]
+            h_block = jax.lax.ppermute(h_block, "graph", _ring_perm(g_size))
+            return h_block, emb
+
+        _, emb = jax.lax.fori_loop(
+            0, g_size, body,
+            (h_local, jnp.zeros((pi, h_local.shape[1]), h_local.dtype)))
+        return emb
+
+    def local_gnn_tick(params, features, kind, nmask, esrc, edst, erel,
+                       emask, ints):
+        ints = ints[0]                       # [1, L] graph-shard block
+        f_idx = ints[:pk]                    # already shard-local
+        kind_v = ints[pk:2 * pk]
+        nmask_v = ints[2 * pk:3 * pk].astype(jnp.float32)
+        o = 3 * pk
+        e_idx = ints[o:o + ek]               # already region-local
+        e_src = ints[o + ek:o + 2 * ek]
+        e_dst = ints[o + 2 * ek:o + 3 * ek]
+        e_rel = ints[o + 3 * ek:o + 4 * ek]
+        e_mask = ints[o + 4 * ek:o + 5 * ek].astype(jnp.float32)
+        o += 5 * ek
+        inc_nodes = ints[o:o + pi]
+        inc_mask = ints[o + pi:o + 2 * pi].astype(jnp.float32)
+
+        kind = kind.at[f_idx].set(kind_v, mode="drop")
+        nmask = nmask.at[f_idx].set(nmask_v, mode="drop")
+        esrc = esrc.at[e_idx].set(e_src, mode="drop")
+        edst = edst.at[e_idx].set(e_dst, mode="drop")
+        erel = erel.at[e_idx].set(e_rel, mode="drop")
+        emask = emask.at[e_idx].set(e_mask, mode="drop")
+
+        # local degree of local dst rows (every dst's edges live here)
+        deg = jnp.zeros(nodes_per_shard, features.dtype
+                        ).at[edst].add(emask, mode="drop")
+        inv_deg = jnp.where(deg > 0, 1.0 / jnp.maximum(deg, 1.0), 0.0)
+
+        h = jax.nn.relu(features @ params["embed_w"] + params["embed_b"]
+                        + params["kind_emb"][kind])
+        h = h * nmask[:, None]
+        src_iota = jax.lax.iota(jnp.int32, pe_shard)
+        for layer in params["layers"]:
+            rows = _assemble_ring(h, esrc)
+            agg = gather_matmul_segment(
+                rows, layer["w_rel"], src_iota, edst, emask,
+                rel_offsets, nodes_per_shard,
+                slices_sorted=slices_sorted,
+                compute_dtype=compute_dtype) * inv_deg[:, None]
+            if compute_dtype is not None:
+                self_t = jax.lax.dot(h.astype(compute_dtype),
+                                     layer["w_self"].astype(compute_dtype),
+                                     preferred_element_type=h.dtype)
+            else:
+                self_t = h @ layer["w_self"]
+            h = jax.nn.relu(self_t + agg + layer["b"]) + h
+
+        emb = _readout_ring(h, inc_nodes)
+        logits = emb @ params["head_w"] + params["head_b"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        # mask dead incident rows so a stale row can never surface a score
+        probs = probs * inc_mask[:, None]
+        return kind, nmask, esrc, edst, erel, emask, logits, probs
+
+    g, r = P("graph"), P()
+    gnn_tick = shard_map(
+        local_gnn_tick, mesh=mesh,
+        in_specs=(r, g, g, g, g, g, g, g, g),
+        # logits/probs are complete AND identical on every shard after
+        # the readout ring — replicated outputs
+        out_specs=(g,) * 6 + (r, r),
+        check_vma=False,
+    )
+    # donation contract of _gnn_tick: the resident mirror (kind/nmask +
+    # the four edge regions) is donated; params and the base scorer's
+    # features must survive the tick
+    return jax.jit(gnn_tick, donate_argnums=(2, 3, 4, 5, 6, 7))
+
+
+def shared_shard_offsets(counts_by_shard: np.ndarray, slack: float,
+                         min_cap: int) -> tuple[int, ...]:
+    """Shared per-shard relation-slice offsets: capacity per relation is
+    the MAX live count over shards, bucketed — one static offsets tuple
+    describes EVERY shard's region (the partition.py contract), which is
+    what lets the shard_map'd tick compile once."""
+    from ..graph.snapshot import rel_slice_offsets
+    counts = np.asarray(counts_by_shard, np.int64)
+    return rel_slice_offsets(counts.max(axis=0), slack=slack,
+                             min_cap=min_cap)

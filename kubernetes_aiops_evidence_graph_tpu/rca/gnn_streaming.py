@@ -142,7 +142,13 @@ class GnnStreamingScorer(StreamingScorer):
             # given, not the global env-derived ones (code-review r5)
             params = GnnRcaBackend(settings=settings).params
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
-        if mesh is not None:
+        # graft-fleet: a mesh with a real ``graph`` axis is served by the
+        # sharded GNN tick (parallel/sharded_streaming.sharded_gnn_tick:
+        # per-shard edge regions, ring-halo message pass). A dp-only mesh
+        # has no sharded-GNN mapping (incident readout is not dp-sharded
+        # here) and falls back to single-device as before.
+        if mesh is not None and ("graph" not in getattr(mesh, "axis_names", ())
+                                 or mesh.shape["graph"] <= 1):
             log.warning("gnn_streaming_mesh_unsupported")
             mesh = None
         # kernel selection (set BEFORE super().__init__, which builds the
@@ -188,15 +194,38 @@ class GnnStreamingScorer(StreamingScorer):
         self._gnn_seq = self._synced_seq
         self._mirror_init()
 
+    def _mirror_graph_sharded(self) -> bool:
+        """Whether a (re)mirror of the CURRENT shapes lands sharded."""
+        return self._graph_sharded(self.snapshot.padded_nodes,
+                                   self.snapshot.padded_incidents)
+
     def _mirror_offsets_now(self) -> tuple[int, ...]:
         """The relation-region offsets a re-mirror of the CURRENT store
         would derive — the single derivation shared by _mirror_init and
         warm_growth, so the warm pre-compiles the shapes a rebuild will
-        actually land on."""
+        actually land on. In graph-sharded mode these are the SHARED
+        per-shard region capacities (max live count over shards, the
+        partition.py contract): one static tuple describes every shard."""
         from ..graph.schema import RelationKind
         from ..graph.snapshot import REL_SLICE_BUCKETS, rel_slice_offsets
-        counts = np.zeros(len(RelationKind), np.int64)
+        num_rels = len(RelationKind)
         _, edges = self.store._raw()
+        if self._mirror_graph_sharded():
+            from ..parallel.sharded_streaming import shared_shard_offsets
+            g = self._graph_size()
+            nps = self.snapshot.padded_nodes // g
+            counts = np.zeros((g, num_rels), np.int64)
+            for e in edges:
+                srow = self._id_to_idx.get(e.src)
+                drow = self._id_to_idx.get(e.dst)
+                if srow is None or drow is None:
+                    continue
+                # each direction lives on its DESTINATION's owner shard
+                counts[drow // nps, int(e.kind)] += 1
+                counts[srow // nps, int(e.kind)] += 1
+            return shared_shard_offsets(counts, slack=1 / 3,
+                                        min_cap=REL_SLICE_BUCKETS[0])
+        counts = np.zeros(num_rels, np.int64)
         for e in edges:
             counts[int(e.kind)] += 2           # both directions
         # 1/3 growth slack per region + a minimum slice per relation so
@@ -223,24 +252,44 @@ class GnnStreamingScorer(StreamingScorer):
         promise at the first in-place delta; a region running out of
         slots falls back to a full re-mirror with re-derived capacities
         (counted in stats via the journal-truncation/rebuild paths that
-        also call this)."""
+        also call this).
+
+        graft-fleet: in graph-sharded mode the slot space becomes D
+        stacked per-shard region sets — shard g owns global slots
+        [g·Pe_shard, (g+1)·Pe_shard) with the SHARED static offsets per
+        relation (max live count over shards, the partition.py contract).
+        Each directed entry lives on its DESTINATION row's owner shard
+        and stores its dst SHARD-LOCAL (the tick's segment-sum is
+        shard-local); src stays global (the ring assembly resolves it).
+        The within-region fill keeps the same STABLE dst sort as the
+        single-device layout, so a dst's edges keep store order in both —
+        which is why a freshly-mirrored sharded tick is bit-identical to
+        the single-device one (only slot REUSE under churn diverges the
+        per-dst accumulation order, to float tolerance)."""
         from ..graph.schema import RelationKind
         offs = self._mirror_offsets_now()
         num_rels = len(RelationKind)
-        pe = max(int(offs[-1]), 1)
         pn = self.snapshot.padded_nodes
+        self._mirror_sharded = self._mirror_graph_sharded()
+        g = self._graph_size() if self._mirror_sharded else 1
+        nps = pn // g
+        pe_shard = max(int(offs[-1]), 1)
+        self._pe_shard = pe_shard
+        pe = pe_shard * g
         _, edges = self.store._raw()
         esrc = np.zeros(pe, np.int32)
-        # padding dst pinned to the last row (as build_snapshot does) so
-        # the tail of every slice keeps the sorted promise; masks zero it
-        edst = np.full(pe, pn - 1, np.int32)
+        # padding dst pinned to the last (shard-local) row so the tail of
+        # every slice keeps the sorted promise; masks zero it
+        edst = np.full(pe, nps - 1, np.int32)
         erel = np.full(pe, -1, np.int32)
         emask = np.zeros(pe, np.float32)
         self._edge_slot: dict[_EdgeKey, tuple[int, int]] = {}
         self._node_edges: dict[str, set[_EdgeKey]] = {}
-        # (dst_row, src_row, key, is_fwd) per relation, then dst-sorted
+        # (dst_local, src_row, key, is_fwd) per (shard, relation) region,
+        # then dst-sorted (with g=1 this is exactly the old per-relation
+        # layout: dst_local == dst, one region set)
         directed: list[list[tuple[int, int, _EdgeKey, bool]]] = [
-            [] for _ in range(num_rels)]
+            [] for _ in range(g * num_rels)]
         for e in edges:
             srow = self._id_to_idx.get(e.src)
             drow = self._id_to_idx.get(e.dst)
@@ -248,41 +297,72 @@ class GnnStreamingScorer(StreamingScorer):
                 continue
             key = (e.src, e.dst, int(e.kind))
             r = int(e.kind)
-            directed[r].append((drow, srow, key, True))
-            directed[r].append((srow, drow, key, False))
+            directed[(drow // nps) * num_rels + r].append(
+                (drow % nps, srow, key, True))
+            directed[(srow // nps) * num_rels + r].append(
+                (srow % nps, drow, key, False))
             self._node_edges.setdefault(e.src, set()).add(key)
             self._node_edges.setdefault(e.dst, set()).add(key)
-        fill = [int(offs[r]) for r in range(num_rels)]
         slots_by_key: dict[_EdgeKey, dict[bool, int]] = {}
-        for r in range(num_rels):
-            directed[r].sort(key=lambda t: t[0])   # stable: dst only
-            for drow, srow, key, fwd in directed[r]:
-                slot = fill[r]
-                fill[r] += 1
-                esrc[slot], edst[slot], emask[slot] = srow, drow, 1.0
-                erel[slot] = r
-                slots_by_key.setdefault(key, {})[fwd] = slot
+        self._free_edge_slots: list[list[int]] = []
+        for region in range(g * num_rels):
+            gi, r = divmod(region, num_rels)
+            base = gi * pe_shard
+            ents = directed[region]
+            ents.sort(key=lambda t: t[0])   # stable: dst_local only
+            fill = base + int(offs[r])
+            for dloc, srow, key, fwd in ents:
+                esrc[fill], edst[fill], emask[fill] = srow, dloc, 1.0
+                erel[fill] = r
+                slots_by_key.setdefault(key, {})[fwd] = fill
+                fill += 1
+            # per-(shard, relation) free slot lists (allocation stays
+            # region-local, which keeps the static offsets valid)
+            self._free_edge_slots.append(
+                list(range(base + int(offs[r + 1]) - 1, fill - 1, -1)))
         for key, by_dir in slots_by_key.items():
             self._edge_slot[key] = (by_dir[True], by_dir[False])
         self._rel_offsets: tuple[int, ...] = offs
-        # per-relation free slot lists (allocation stays region-local)
-        self._free_edge_slots: list[list[int]] = [
-            list(range(int(offs[r + 1]) - 1, fill[r] - 1, -1))
-            for r in range(num_rels)]
         self._esrc_dev = jnp.asarray(esrc)
         self._edst_dev = jnp.asarray(edst)
         self._erel_dev = jnp.asarray(erel)
         self._emask_dev = jnp.asarray(emask)
         self._kind_dev = jnp.asarray(self.snapshot.node_kind)
         self._nmask_dev = jnp.asarray(self.snapshot.node_mask)
-        # directed slot -> (src_row, dst_row, rel_kind, mask)
+        # directed slot -> (src_row, dst_local, rel_kind, mask)
         self._pending_edges: dict[int, tuple[int, int, int, int]] = {}
         # a fresh re-mirror IS dst-sorted per slice; in-place churn
         # (_packed_gnn_delta) forfeits the promise until the next one
         self._slices_sorted = True
         self._last_gnn: tuple | None = None
+        self._apply_sharding()   # place the fresh mirror on the mesh
 
     # -- journal-driven mirror maintenance --------------------------------
+
+    def _nodes_per_shard(self) -> int:
+        return self.snapshot.padded_nodes // (
+            self._graph_size() if self._mirror_sharded else 1)
+
+    def _dst_region(self, kind: int, dst_row: int) -> int:
+        """Free-list index of the region a directed slot targeting
+        ``dst_row`` allocates from: (owner shard, relation) in sharded
+        mode, relation alone otherwise."""
+        if not self._mirror_sharded:
+            return kind
+        from ..graph.schema import RelationKind
+        return (dst_row // self._nodes_per_shard()) * len(RelationKind) \
+            + kind
+
+    def _slot_region(self, kind: int, slot: int) -> int:
+        """Region index of an EXISTING slot (owner from the slot space)."""
+        if not self._mirror_sharded:
+            return kind
+        from ..graph.schema import RelationKind
+        return (slot // self._pe_shard) * len(RelationKind) + kind
+
+    def _dst_local(self, row: int) -> int:
+        return row % self._nodes_per_shard() if self._mirror_sharded \
+            else row
 
     def _mirror_add(self, src: str, dst: str, kind: int) -> None:
         key = (src, dst, kind)
@@ -292,19 +372,21 @@ class GnnStreamingScorer(StreamingScorer):
         drow = self._id_to_idx.get(dst)
         if srow is None or drow is None:
             return   # endpoint removed later in this batch: edge is gone too
-        free = self._free_edge_slots[kind]
-        if len(free) < 2:
-            # this relation's region overflowed: full re-mirror with
-            # re-derived capacities (the bucketed-layout fallback — the
-            # static offsets can't stretch in place)
+        rf = self._dst_region(kind, drow)   # fwd entry: dst-owner region
+        rr = self._dst_region(kind, srow)   # rev entry: src-owner region
+        free_f, free_r = self._free_edge_slots[rf], self._free_edge_slots[rr]
+        if len(free_f) < (2 if rf == rr else 1) or len(free_r) < 1:
+            # a region overflowed: full re-mirror with re-derived
+            # capacities (the bucketed-layout fallback — the static
+            # offsets can't stretch in place)
             self._mirror_init()
             return
-        slot_f, slot_r = free.pop(), free.pop()
+        slot_f, slot_r = free_f.pop(), free_r.pop()
         self._edge_slot[key] = (slot_f, slot_r)
         self._node_edges.setdefault(src, set()).add(key)
         self._node_edges.setdefault(dst, set()).add(key)
-        self._pending_edges[slot_f] = (srow, drow, kind, 1)
-        self._pending_edges[slot_r] = (drow, srow, kind, 1)
+        self._pending_edges[slot_f] = (srow, self._dst_local(drow), kind, 1)
+        self._pending_edges[slot_r] = (drow, self._dst_local(srow), kind, 1)
 
     def _mirror_del(self, key: _EdgeKey) -> None:
         slots = self._edge_slot.pop(key, None)
@@ -318,7 +400,8 @@ class GnnStreamingScorer(StreamingScorer):
                 if not s:
                     del self._node_edges[nid]
         for slot in slots:
-            self._free_edge_slots[kind].append(slot)   # back to ITS region
+            # back to ITS region (per-(shard, relation) in sharded mode)
+            self._free_edge_slots[self._slot_region(kind, slot)].append(slot)
             self._pending_edges[slot] = (0, 0, -1, 0)
 
     def _drain_edges(self) -> None:
@@ -401,6 +484,84 @@ class GnnStreamingScorer(StreamingScorer):
         ]).astype(np.int32, copy=False)
         return ints, pk, ek
 
+    def _packed_gnn_delta_sharded(self, aux_rows: list[int]
+                                  ) -> tuple[np.ndarray, int, int]:
+        """Per-shard packed delta for the sharded GNN tick
+        (parallel/sharded_streaming.sharded_gnn_tick): aux (kind/nmask)
+        deltas route to their node-owner shard, edge-slot deltas to their
+        slot-owner shard, each with per-shard _DELTA_BUCKETS sub-buckets
+        (compiled width = max over shards, so one hot shard doesn't
+        retrace the others); the [Pi] incident tables ride replicated in
+        every shard's row. Store-journal order is preserved WITHIN each
+        shard — the router walks the pending maps in insertion order."""
+        from ..parallel.sharded_streaming import route_node_delta
+        from ..utils.padding import bucket_for
+        g = self._graph_size()
+        pi = self.snapshot.padded_incidents
+        pn = self.snapshot.padded_nodes
+        nps = pn // g
+
+        f_idx, per_aux, pk = route_node_delta(
+            [(r,) for r in aux_rows], nps, g, _DELTA_BUCKETS)
+        kind_v = np.zeros((g, pk), np.int32)
+        nmask_v = np.zeros((g, pk), np.int32)
+        for gi, ents in enumerate(per_aux):
+            for j, (row,) in enumerate(ents):
+                kind_v[gi, j] = self.snapshot.node_kind[row]
+                nmask_v[gi, j] = int(self.snapshot.node_mask[row])
+
+        pe_shard = self._pe_shard
+        per_edge: list[list] = [[] for _ in range(g)]
+        for slot, (srow, dloc, rel, m) in self._pending_edges.items():
+            per_edge[slot // pe_shard].append(
+                (slot % pe_shard, srow, dloc, rel, m))
+        self._pending_edges = {}
+        if max((len(s) for s in per_edge), default=0) > _DELTA_BUCKETS[-1]:
+            # a per-shard delta beyond the ladder would mint a fresh
+            # power-of-two compile mid-serve; a full re-mirror (no compile
+            # at unchanged shapes) resets pending entirely
+            self._mirror_init()
+            per_edge = [[] for _ in range(g)]
+            pe_shard = self._pe_shard
+        if any(per_edge):
+            # in-place slot reuse breaks within-slice dst order until the
+            # next full re-mirror
+            self._slices_sorted = False
+        ek = bucket_for(
+            max(max((len(s) for s in per_edge), default=0), 1),
+            _DELTA_BUCKETS)
+        e_idx = np.full((g, ek), pe_shard, np.int32)
+        e_src = np.zeros((g, ek), np.int32)
+        e_dst = np.zeros((g, ek), np.int32)
+        e_rel = np.full((g, ek), -1, np.int32)
+        e_mask = np.zeros((g, ek), np.int32)
+        for gi, shard_ents in enumerate(per_edge):
+            for j, (sl, s, d, r, m) in enumerate(shard_ents):
+                e_idx[gi, j], e_src[gi, j], e_dst[gi, j] = sl, s, d
+                e_rel[gi, j], e_mask[gi, j] = r, m
+        inc_n = np.broadcast_to(
+            self.snapshot.incident_nodes.astype(np.int32), (g, pi))
+        inc_m = np.broadcast_to(
+            self.snapshot.incident_mask.astype(np.int32), (g, pi))
+        ints = np.concatenate(
+            [f_idx, kind_v, nmask_v, e_idx, e_src, e_dst, e_rel, e_mask,
+             inc_n, inc_m], axis=1).astype(np.int32, copy=False)
+        return ints, pk, ek
+
+    def _sharded_tick_fn(self, pk: int, ek: int):
+        """The sharded GNN tick for the CURRENT shapes. The sharded path
+        always runs the relation-bucketed XLA kernel: the mirror layout
+        is bucketed regardless, and the Pallas tier stays a single-device
+        lowering (the shield's kernel-fallback rung is a no-op here)."""
+        from ..parallel.sharded_streaming import sharded_gnn_tick
+        g = self._graph_size()
+        return sharded_gnn_tick(
+            self.mesh, self.snapshot.padded_nodes // g, self._pe_shard,
+            self.snapshot.padded_incidents, pk, ek,
+            rel_offsets=self._rel_offsets,
+            slices_sorted=bool(self._slices_sorted),
+            compute_dtype=self._compute_dtype)
+
     def _tick_handles(self, out: tuple) -> tuple:
         """The pipeline queue tracks the GNN tick's outputs: in gnn mode
         the base rules handles are never fetched, so the GNN probs are
@@ -412,6 +573,7 @@ class GnnStreamingScorer(StreamingScorer):
     _HOST_STATE_ATTRS = StreamingScorer._HOST_STATE_ATTRS + (
         "_gnn_seq", "_rel_offsets", "_slices_sorted",
         "_edge_slot", "_node_edges", "_free_edge_slots", "_pending_edges",
+        "_mirror_sharded", "_pe_shard",
     )
 
     def _resident_arrays(self) -> list:
@@ -425,9 +587,35 @@ class GnnStreamingScorer(StreamingScorer):
          self._erel_dev, self._emask_dev) = (jnp.asarray(p)
                                              for p in parts[4:])
         self._last_gnn = None
+        # the base call placed only ITS arrays (the mirror handles still
+        # held pre-restore buffers then); re-place now that the restored
+        # mirror is installed — device_put with an unchanged sharding is
+        # free, so the unsharded path costs nothing
+        self._apply_sharding()
+
+    def _apply_sharding(self) -> None:
+        super()._apply_sharding()
+        if not getattr(self, "_mirror_sharded", False) or \
+                getattr(self, "_esrc_dev", None) is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        gsh = NamedSharding(self.mesh, P("graph"))
+        self._kind_dev = jax.device_put(self._kind_dev, gsh)
+        self._nmask_dev = jax.device_put(self._nmask_dev, gsh)
+        self._esrc_dev = jax.device_put(self._esrc_dev, gsh)
+        self._edst_dev = jax.device_put(self._edst_dev, gsh)
+        self._erel_dev = jax.device_put(self._erel_dev, gsh)
+        self._emask_dev = jax.device_put(self._emask_dev, gsh)
 
     def _pending_delta_count(self) -> int:
-        # each pending edge entry is one directed slot in the packed delta
+        # each pending edge entry is one directed slot in the packed
+        # delta; in sharded mode the compiled width follows the MAX
+        # per-shard count (per-shard sub-buckets bound the ladder)
+        if getattr(self, "_mirror_sharded", False):
+            per = [0] * self._graph_size()
+            for slot in self._pending_edges:
+                per[slot // self._pe_shard] += 1
+            return super()._pending_delta_count() + max(per)
         return super()._pending_delta_count() + len(self._pending_edges)
 
     def dispatch(self) -> tuple:
@@ -437,14 +625,25 @@ class GnnStreamingScorer(StreamingScorer):
         aux_rows = list(self._pending_feat.keys())
         out = super().dispatch()
         self._drain_edges()
-        ints, pk, ek = self._packed_gnn_delta(aux_rows)
-        (self._kind_dev, self._nmask_dev, self._esrc_dev, self._edst_dev,
-         self._erel_dev, self._emask_dev, logits, probs) = _gnn_tick(
-            self._params, self._features_dev, self._kind_dev,
-            self._nmask_dev, self._esrc_dev, self._edst_dev,
-            self._erel_dev, self._emask_dev, jnp.asarray(ints),
-            pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
-            **self._tick_statics())
+        if self._mirror_sharded:
+            ints, pk, ek = self._packed_gnn_delta_sharded(aux_rows)
+            tick = self._sharded_tick_fn(pk, ek)
+            (self._kind_dev, self._nmask_dev, self._esrc_dev,
+             self._edst_dev, self._erel_dev, self._emask_dev, logits,
+             probs) = tick(
+                self._params, self._features_dev, self._kind_dev,
+                self._nmask_dev, self._esrc_dev, self._edst_dev,
+                self._erel_dev, self._emask_dev, jnp.asarray(ints))
+        else:
+            ints, pk, ek = self._packed_gnn_delta(aux_rows)
+            (self._kind_dev, self._nmask_dev, self._esrc_dev,
+             self._edst_dev, self._erel_dev, self._emask_dev, logits,
+             probs) = _gnn_tick(
+                self._params, self._features_dev, self._kind_dev,
+                self._nmask_dev, self._esrc_dev, self._edst_dev,
+                self._erel_dev, self._emask_dev, jnp.asarray(ints),
+                pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
+                **self._tick_statics())
         self._last_gnn = (logits, probs)
         return out
 
@@ -515,6 +714,17 @@ class GnnStreamingScorer(StreamingScorer):
                         ((True, False) if self._use_bucketed else (False,))]
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
             inc_m = self.snapshot.incident_mask.astype(np.int32)
+            sharded = bool(getattr(self, "_mirror_sharded", False))
+            g = self._graph_size() if sharded else 1
+            pe_shard = getattr(self, "_pe_shard", pe)
+            offs = self._rel_offsets
+            compute_dtype = self._compute_dtype if self._use_bucketed \
+                else None
+        if sharded:
+            self._warm_gnn_sharded(delta_sizes, edge_sizes, pi, pn, g,
+                                   pe, pe_shard, offs, compute_dtype,
+                                   params, features_dev, inc_n, inc_m)
+            return
         for statics in variants:
             for pk in delta_sizes:
                 for ek in edge_sizes:
@@ -537,6 +747,53 @@ class GnnStreamingScorer(StreamingScorer):
                               jnp.zeros(pe, jnp.float32),
                               jnp.asarray(ints), pk=pk, ek=ek,
                               pi=pi, **statics)
+
+    def _sharded_gnn_standins(self, pn: int, pe: int):
+        """Fresh zero stand-ins for the sharded tick's DONATED mirror
+        positions, placed exactly like the live state (executables key on
+        input shardings)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        gsh = NamedSharding(self.mesh, P("graph"))
+        return (jax.device_put(jnp.zeros(pn, jnp.int32), gsh),
+                jax.device_put(jnp.zeros(pn, jnp.float32), gsh),
+                jax.device_put(jnp.zeros(pe, jnp.int32), gsh),
+                jax.device_put(jnp.zeros(pe, jnp.int32), gsh),
+                jax.device_put(jnp.full((pe,), -1, jnp.int32), gsh),
+                jax.device_put(jnp.zeros(pe, jnp.float32), gsh))
+
+    def _warm_gnn_sharded(self, delta_sizes, edge_sizes, pi, pn, g, pe,
+                          pe_shard, offs, compute_dtype, params,
+                          features_dev, inc_n, inc_m) -> None:
+        """Sharded-tick warm: per-shard all-dropped [G, L] deltas at the
+        same bucket ladder, both sorted variants, stand-ins placed on the
+        mesh (the donated mirror must never see the live handles)."""
+        from ..parallel.sharded_streaming import sharded_gnn_tick
+        nps = pn // g
+        inc_rep = (np.broadcast_to(inc_n, (g, pi)),
+                   np.broadcast_to(inc_m, (g, pi)))
+        for ss in (True, False):
+            for pk in delta_sizes:
+                for ek in edge_sizes:
+                    if self._warm_stop:
+                        return
+                    ints = np.concatenate([
+                        np.full((g, pk), nps, np.int32),
+                        np.zeros((g, pk), np.int32),
+                        np.zeros((g, pk), np.int32),
+                        np.full((g, ek), pe_shard, np.int32),
+                        np.zeros((g, ek), np.int32),
+                        np.zeros((g, ek), np.int32),
+                        np.full((g, ek), -1, np.int32),
+                        np.zeros((g, ek), np.int32),
+                        *inc_rep,
+                    ], axis=1).astype(np.int32, copy=False)
+                    tick = sharded_gnn_tick(
+                        self.mesh, nps, pe_shard, pi, pk, ek,
+                        rel_offsets=offs, slices_sorted=ss,
+                        compute_dtype=compute_dtype)
+                    tick(params, features_dev,
+                         *self._sharded_gnn_standins(pn, pe),
+                         jnp.asarray(ints))
 
     def warm_growth(self) -> None:
         """Base growth shapes, then the GNN tick at every (pn, offsets,
@@ -564,7 +821,39 @@ class GnnStreamingScorer(StreamingScorer):
             for offs in {offs_cur, offs_now}:
                 if self._warm_stop:
                     return
-                cpe = max(int(offs[-1]), 1)
+                pe_shard = max(int(offs[-1]), 1)
+                if self._graph_sharded(cpn, cpi):
+                    # rebuilds at divisible shapes stay sharded: warm the
+                    # mesh-resident tick at the rebuild-derived offsets
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    from ..parallel.sharded_streaming import (
+                        sharded_gnn_tick)
+                    g = self._graph_size()
+                    cpe = pe_shard * g
+                    ints = np.concatenate([
+                        np.full((g, pk), cpn // g, np.int32),
+                        np.zeros((g, pk), np.int32),
+                        np.zeros((g, pk), np.int32),
+                        np.full((g, ek), pe_shard, np.int32),
+                        np.zeros((g, ek), np.int32),
+                        np.zeros((g, ek), np.int32),
+                        np.full((g, ek), -1, np.int32),
+                        np.zeros((g, ek), np.int32),
+                        np.zeros((g, 2 * cpi), np.int32),
+                    ], axis=1).astype(np.int32, copy=False)
+                    gsh = NamedSharding(self.mesh, PartitionSpec("graph"))
+                    feats = jax.device_put(
+                        jnp.zeros((cpn, dim), jnp.float32), gsh)
+                    tick = sharded_gnn_tick(
+                        self.mesh, cpn // g, pe_shard, cpi, pk, ek,
+                        rel_offsets=offs, slices_sorted=True,
+                        compute_dtype=self._compute_dtype
+                        if self._use_bucketed else None)
+                    tick(self._params, feats,
+                         *self._sharded_gnn_standins(cpn, cpe),
+                         jnp.asarray(ints))
+                    continue
+                cpe = pe_shard
                 ints = np.concatenate([
                     np.full(pk, cpn, np.int32), np.zeros(pk, np.int32),
                     np.zeros(pk, np.int32),

@@ -501,20 +501,33 @@ class TpuRcaBackend:
 
         Accepts either a snapshot to score, or a pre-computed ``raw`` dict —
         e.g. a StreamingScorer.rescore() result, whose keys are identical —
-        in which case no snapshot is needed at all (the serving path)."""
+        in which case no snapshot is needed at all (the serving path).
+
+        A NARROWED raw dict (``score_snapshot(fields="top")`` — no
+        ``matched``/``scores`` tables, they never left the device)
+        materializes the TOP hypothesis only: per-incident top rule +
+        top score at rank 1, the verdict the workflow acts on
+        (runbook/remediation key off ``top_hypothesis``). The wide fetch
+        stays the path for every-matched-rule hypothesis lists."""
         if raw is None:
             if snapshot is None:
                 raise ValueError("results() needs a snapshot or a raw dict")
             raw = self.score_snapshot(snapshot)
+        narrowed = "matched" not in raw or "scores" not in raw
         out: list[RCAResult] = []
         for i, inc_id in enumerate(raw["incident_ids"]):
             uid = _incident_uuid(inc_id)
             hyps: list[Hypothesis] = []
             if raw["any_match"][i]:
-                matched_rules = [
-                    (RULES[r], float(raw["scores"][i, r])) for r in range(NUM_RULES)
-                    if raw["matched"][i, r]
-                ]
+                if narrowed:
+                    matched_rules = [
+                        (RULES[int(raw["top_rule_index"][i])],
+                         float(raw["top_score"][i]))]
+                else:
+                    matched_rules = [
+                        (RULES[r], float(raw["scores"][i, r]))
+                        for r in range(NUM_RULES) if raw["matched"][i, r]
+                    ]
                 matched_rules.sort(key=lambda t: t[1], reverse=True)
                 for rank, (rule, score) in enumerate(matched_rules, start=1):
                     hyps.append(Hypothesis(

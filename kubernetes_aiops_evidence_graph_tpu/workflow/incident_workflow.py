@@ -198,7 +198,19 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
         if backend_name in ("tpu", "gnn"):   # snapshot-scoring backends
             snapshot = build_snapshot(ctx.builder.store, ctx.settings)
             backend = get_backend(backend_name)
-            all_results = backend.results(snapshot)
+            if backend_name == "tpu":
+                # graft-fleet satellite (ROADMAP item 2 slice): the
+                # narrowed verdict fetch is the DEFAULT — the wide
+                # conditions/matched/scores tables never leave the device
+                # unless settings.workflow_verdict_fields asks for them
+                # ("full"/"all" restores every-matched-rule hypotheses)
+                mode = getattr(ctx.settings, "workflow_verdict_fields",
+                               "top")
+                fields = "full" if mode in ("full", "all") else "top"
+                all_results = backend.results(
+                    raw=backend.score_snapshot(snapshot, fields=fields))
+            else:
+                all_results = backend.results(snapshot)
             mine = [r for r in all_results
                     if str(r.incident_id) == str(ctx.incident.id)]
             hyps = mine[0].hypotheses if mine else []

@@ -97,20 +97,30 @@ class IncidentWorker:
             return self.scorer
 
     def _serving_mesh(self):
-        """settings.mesh_dp > 1 -> a dp mesh over that many devices: the
-        resident scorer's incident tables shard across the slice (see
-        StreamingScorer mesh param). None = single-device serving."""
-        dp = self.settings.mesh_dp
-        if dp <= 1:
+        """settings.mesh_dp > 1 -> a dp mesh (incident tables shard);
+        settings.serve_graph_shards > 1 -> a (dp × graph) mesh whose
+        graph axis carries the RESIDENT state itself (graft-fleet:
+        node/feature/evidence tables + the GNN edge mirror split into
+        graph partitions, mesh-resident ticks —
+        parallel/sharded_streaming.py). None = single-device serving."""
+        dp = max(int(self.settings.mesh_dp), 1)
+        graph = max(int(getattr(self.settings, "serve_graph_shards", 1)), 1)
+        if dp <= 1 and graph <= 1:
             return None
         import jax
         import numpy as _np
         from jax.sharding import Mesh
+        from ..parallel.mesh import ensure_host_devices
+        need = dp * graph
+        ensure_host_devices(need)
         devices = jax.devices()
-        if len(devices) < dp:
-            log.warning("mesh_dp_exceeds_devices", mesh_dp=dp,
-                        devices=len(devices))
+        if len(devices) < need:
+            log.warning("serving_mesh_exceeds_devices", mesh_dp=dp,
+                        serve_graph_shards=graph, devices=len(devices))
             return None
+        if graph > 1:
+            return Mesh(_np.array(devices[:need]).reshape(dp, graph),
+                        ("dp", "graph"))
         return Mesh(_np.array(devices[:dp]), ("dp",))
 
     async def submit(self, incident: Incident) -> None:

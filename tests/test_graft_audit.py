@@ -93,7 +93,12 @@ def test_shipped_ticks_declare_their_mirror_state_donation():
     their mirror-state donation declared — dropping a donate_argnums
     regresses to per-tick reallocation of the full resident set."""
     assert JIT_DECLARATIONS[("rca/streaming.py", "_tick")][1] == (0, 3, 4, 5)
-    assert JIT_DECLARATIONS[("rca/streaming.py", "tick")][1] == (0, 3, 4, 5)
+    # graft-fleet mesh-resident ticks carry the same donation contract
+    assert JIT_DECLARATIONS[
+        ("parallel/sharded_streaming.py", "rules_tick")][1] == (0, 3, 4, 5)
+    assert JIT_DECLARATIONS[
+        ("parallel/sharded_streaming.py", "gnn_tick")][1] == \
+        (2, 3, 4, 5, 6, 7)
     assert JIT_DECLARATIONS[("rca/gnn_streaming.py", "_gnn_tick")][1] == \
         (2, 3, 4, 5, 6, 7)
     # the registry audits the coalesced tick shapes too (queue-full merges)

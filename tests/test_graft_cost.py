@@ -226,11 +226,27 @@ def test_registry_pins_the_collective_contracts():
     ag = BY_NAME["sharded_gnn.loss.allgather.bucketed"].cost
     assert ag.expect_counts["all_gather"] == LAYERS + 1
     assert ag.max_total_bytes is not None and ring.max_total_bytes is not None
+    # graft-fleet streaming ticks: the GNN tick obeys the SAME ring
+    # contract as the snapshot kernels — exactly (LAYERS+1)*D ppermutes
+    # of [N/D, H] blocks, zero [N, H] all-gathers; the rules tick needs
+    # only ONE verdict psum and no block movement at all
+    fleet_gnn = BY_NAME["streaming.gnn_tick.sharded"].cost
+    assert fleet_gnn.expect_counts["ppermute"] == \
+        (LAYERS + 1) * GRAPH_SHARDS
+    assert fleet_gnn.expect_counts["all_gather"] == 0
+    assert fleet_gnn.expect_counts["psum"] == 0
+    assert fleet_gnn.max_bytes_per_op["ppermute"] == \
+        (4096 // GRAPH_SHARDS) * HIDDEN * 4
+    fleet_rules = BY_NAME["streaming.rules_tick.sharded"].cost
+    assert fleet_rules.expect_counts["psum"] == 1
+    assert fleet_rules.expect_counts["ppermute"] == 0
+    assert fleet_rules.expect_counts["all_gather"] == 0
     # every single-device entrypoint bans all collectives: either the
     # implicit default (cost=None) or — for the pallas tier, where the
     # acceptance contract pins it explicitly — COST_DEFAULT itself
     for e in ENTRYPOINTS:
-        if not e.name.startswith("sharded_gnn."):
+        if not e.name.startswith("sharded_gnn.") and \
+                not e.name.endswith(".sharded"):
             assert e.cost is None or e.cost is COST_DEFAULT, e.name
     for name in ("ops.pallas_gather_matmul_segment",
                  "ops.pallas_gather_matmul_segment.bf16",

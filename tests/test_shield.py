@@ -353,6 +353,59 @@ def test_worker_acquisition_wraps_scorer_in_shield(tmp_path):
         db.close()
 
 
+# -- graft-fleet: shield recovery on GRAPH-SHARDED resident state ----------
+
+@pytest.mark.parametrize("fault,expects_recovery", [
+    (Fault("snapshot_write", at=1), False),     # crash mid-snapshot
+    (Fault("execute", at=2, kind="device_loss"), True),  # forces restore
+], ids=["crash_mid_snapshot", "device_loss"])
+def test_sharded_state_recovery_bit_identical(fault, expects_recovery):
+    """The shield's snapshot/journal seams must work on the sharded
+    resident state (serve_graph_shards=2): the snapshot pack fetches the
+    shard blocks through one device_get (host-side assembly), recovery
+    re-distributes via _apply_sharding. Crash with D=2, recover, and the
+    verdicts must be bit-identical BOTH to the unfaulted D=2 replay AND
+    to the D=1 scorer on the same churn script."""
+    cfg = dict(serve_graph_shards=2)
+    out_f, shield_f, inj_f = _run_churn(2, faults=[fault],
+                                        settings=_settings(2, **cfg))
+    assert shield_f.injector.fired, "fault never fired"
+    s = shield_f.scorer
+    assert s._graph_sharded(s.snapshot.padded_nodes,
+                            s.snapshot.padded_incidents), \
+        "premise: resident state not graph-sharded"
+    if expects_recovery:
+        assert shield_f.recoveries >= 1, shield_f.stats()
+        from jax.sharding import PartitionSpec
+        assert s._features_dev.sharding.spec == PartitionSpec("graph"), \
+            "recovery lost the graph sharding"
+    out_b, shield_b, inj_b = _run_churn(2, settings=_settings(2, **cfg))
+    assert shield_b.recoveries == 0
+    _assert_bit_parity(out_f, out_b, inj_f, inj_b)
+    out_1, _shield_1, inj_1 = _run_churn(2, settings=_settings(2))
+    _assert_bit_parity(out_f, out_1, inj_f, inj_1)
+
+
+def test_sharded_gnn_device_loss_recovers_bit_identical(gnn_params):
+    """Same contract for the sharded GNN scorer at fixed D=2: the
+    per-shard mirror layout is a pure function of the store journal, so
+    snapshot + journal-suffix replay reproduces it bit-identically."""
+    cfg = dict(serve_graph_shards=2)
+    base, bshield, binj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert bshield.recoveries == 0
+    assert bshield.scorer._mirror_sharded, \
+        "premise: GNN mirror not graph-sharded"
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss")],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert shield.recoveries >= 1
+    _assert_bit_parity(out, base, injected, binj)
+    assert np.isfinite(np.asarray(out["probs"])).all()
+
+
 # -- GNN backend under faults (checkpoint-gated) ---------------------------
 
 @pytest.fixture(scope="module")

@@ -134,6 +134,40 @@ def test_full_incident_lifecycle_heals_fault(backend):
     db.close()
 
 
+def test_workflow_default_verdict_path_is_narrowed_fetch():
+    """graft-fleet satellite (ROADMAP item 2 slice): the snapshot-scoring
+    verdict path defaults to ``score_snapshot(fields="top")`` — the wide
+    conditions/matched/scores tables never leave the device, so the
+    ``aiops_serve_fetched_bytes_total`` delta per workflow shrinks
+    strictly — while ``workflow_verdict_fields="all"`` stays reachable
+    and restores the wide fetch. Both paths agree on the verdict."""
+    from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
+        SERVE_FETCHED_BYTES)
+
+    def run_one(fields_mode):
+        cluster, _target, incident, db = _world(seed=9)
+        cfg = load_settings(**{**DEV.__dict__, "rca_backend": "tpu",
+                               "workflow_verdict_fields": fields_mode})
+        b0 = SERVE_FETCHED_BYTES.value(path="score_snapshot")
+        results = _run(run_incident_workflow(incident, cluster, db,
+                                             settings=cfg))
+        nbytes = SERVE_FETCHED_BYTES.value(path="score_snapshot") - b0
+        hyps = db.hypotheses_for(incident.id)
+        db.close()
+        return results, nbytes, hyps
+
+    res_top, top_bytes, hyps_top = run_one("top")
+    res_all, all_bytes, hyps_all = run_one("all")
+    assert res_top["generate_hypotheses"]["top_rule"] == \
+        res_all["generate_hypotheses"]["top_rule"] == \
+        "crashloop_recent_deploy"
+    assert 0 < top_bytes < all_bytes, (top_bytes, all_bytes)
+    # the narrowed path materializes the top hypothesis the workflow
+    # acts on; the wide path still carries every matched rule
+    assert hyps_top[0]["rule_id"] == hyps_all[0]["rule_id"]
+    assert len(hyps_all) >= len(hyps_top) >= 1
+
+
 def test_lifecycle_denied_action_creates_ticket():
     cluster, target, incident, db = _world("imagepull")
     # image_pull_failure has no machine action -> no proposal -> ticket path
