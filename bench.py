@@ -568,6 +568,197 @@ def bench_pipeline_sweep(num_pods: int = 1000, num_incidents: int = 30,
     }
 
 
+def bench_webhook_verdict_slo(num_pods: int = 2000, tenants: int = 4,
+                              events: int = 4000, batch_size: int = 100,
+                              target_eps: int = 1000, seed: int = 0,
+                              verbose: bool = True) -> dict:
+    """graft-scope: the webhook→verdict SLO record (ROADMAP open item 2).
+
+    One resident scorer serves full-mix churn from ``tenants`` namespace
+    groups of one cluster (multi-tenant packing on one resident state):
+    every ``incident_arrival`` in the stream is stamped at its "webhook"
+    boundary (ServeScope), the scorer ticks once per ``batch_size``
+    events, and each caller-boundary rescore closes the latency sample
+    for every incident whose verdict first materialized there. Three
+    passes over the identical seeded script, fresh world each:
+
+    1. **paced, telemetry on** — batches aligned to ``target_eps`` wall
+       time (1k ev/s by default; if the host can't keep up there is no
+       sleep and the achieved rate is reported honestly). This is the
+       run the p50/p99 come from: exact quantiles over the collected
+       samples, with the SLO histogram's interpolated percentiles
+       reported alongside to prove the exported surface agrees.
+    2. **unpaced, telemetry on** and 3. **unpaced, telemetry off** —
+       max-rate walls whose ratio is the telemetry overhead. The
+       perf_contract gate (tests/test_scope.py) pins the same contract
+       microbenched; this field is the full-shape measurement.
+    """
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.observability import (
+        metrics as obs_metrics)
+    from kubernetes_aiops_evidence_graph_tpu.observability.scope import SCOPE
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        StreamingScorer)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        SCENARIOS, generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, stream_step)
+    import jax
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose \
+        else (lambda *a: None)
+
+    def build_world(cfg):
+        cluster = generate_cluster(num_pods=num_pods, seed=seed)
+        rng = np.random.default_rng(seed)
+        builder = GraphBuilder()
+        sync_topology(cluster, builder.store)
+        keys = sorted(cluster.deployments)
+        names = sorted(SCENARIOS)
+        injected = []
+        for i in range(max(tenants * 2, 6)):
+            inc = inject(cluster, names[i % len(names)],
+                         keys[(i * 7) % len(keys)], rng)
+            injected.append(inc)
+            builder.ingest(inc, collect_all(
+                inc, default_collectors(cluster, cfg), parallel=False))
+        scorer = StreamingScorer(builder.store, cfg,
+                                 now_s=cluster.now.timestamp())
+        scorer.rescore()    # warm compile + first fetch (+ roofline trace)
+        scorer.warm(delta_sizes=(64, 256), row_sizes=(4, 16, 64))
+        stream = list(churn_events(
+            cluster, events, seed=seed + 1,
+            incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+        return cluster, builder, scorer, stream
+
+    def tenant_of(namespace: str) -> str:
+        return f"tenant-{hash(namespace) % tenants}"
+
+    def run(telemetry: bool):
+        """Unpaced (max-rate) wall over the identical script on a fresh
+        world; with ``telemetry`` the FULL graft-scope path runs (tick
+        spans, SLO stamps and closes), without it none of it does — the
+        ratio of the two walls is the telemetry overhead."""
+        cfg = load_settings(scope_telemetry=telemetry)
+        cluster, builder, scorer, stream = build_world(cfg)
+        SCOPE.clear()
+        pending: set[str] = set()
+        t_start = time.perf_counter()
+        for s in range(0, len(stream), batch_size):
+            for ev in stream[s:s + batch_size]:
+                stream_step(cluster, builder.store, scorer, ev)
+                if telemetry and ev.kind == "incident_arrival":
+                    iid = f"incident:{ev.name}"
+                    SCOPE.webhook_received(iid,
+                                           tenant=tenant_of(ev.namespace))
+                    pending.add(iid)
+            scorer.tick_async()
+            out = scorer.rescore()   # the verdict boundary per batch
+            if telemetry:
+                served = set(out["incident_ids"])
+                for iid in list(pending):
+                    if iid in served:
+                        SCOPE.verdict_served(iid, backend="rules")
+                        pending.discard(iid)
+        wall = time.perf_counter() - t_start
+        return wall, scorer
+
+    def run_paced_slo():
+        cfg = load_settings(scope_telemetry=True)
+        cluster, builder, scorer, stream = build_world(cfg)
+        SCOPE.clear()
+        arrival_tenant: dict[str, str] = {}
+        samples: dict[str, list[float]] = {}
+        pending: set[str] = set()
+        batch_wall = batch_size / float(target_eps)
+        t_start = time.perf_counter()
+        for s in range(0, len(stream), batch_size):
+            t_batch = time.perf_counter()
+            for ev in stream[s:s + batch_size]:
+                stream_step(cluster, builder.store, scorer, ev)
+                if ev.kind == "incident_arrival":
+                    iid = f"incident:{ev.name}"
+                    ten = tenant_of(ev.namespace)
+                    SCOPE.webhook_received(iid, tenant=ten)
+                    arrival_tenant[iid] = ten
+                    pending.add(iid)
+            scorer.tick_async()
+            out = scorer.rescore()
+            served = set(out["incident_ids"])
+            for iid in list(pending):
+                if iid in served:
+                    lat = SCOPE.verdict_served(iid, backend="rules")
+                    pending.discard(iid)
+                    if lat is not None:
+                        samples.setdefault(
+                            arrival_tenant[iid], []).append(lat)
+            spare = batch_wall - (time.perf_counter() - t_batch)
+            if spare > 0:
+                time.sleep(spare)
+        wall = time.perf_counter() - t_start
+        return wall, samples
+
+    wall_slo, samples = run_paced_slo()
+    all_lat = sorted(lat for ts in samples.values() for lat in ts)
+    if not all_lat:
+        raise SystemExit("SLO bench produced zero webhook→verdict samples")
+    p50 = float(np.percentile(all_lat, 50))
+    p99 = float(np.percentile(all_lat, 99))
+    per_tenant = {
+        t: {"p50_ms": round(float(np.percentile(ts, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(ts, 99)) * 1e3, 2),
+            "samples": len(ts)}
+        for t, ts in sorted(samples.items())
+    }
+    # the exported SLO surface must agree with the exact quantiles to
+    # bucket resolution (Histogram.percentile interpolates in-bucket)
+    hist = obs_metrics.WEBHOOK_VERDICT_LATENCY
+    hist_p50 = max(hist.percentile(0.5, tenant=t, backend="rules",
+                                   shards="1") for t in samples)
+    hist_p99 = max(hist.percentile(0.99, tenant=t, backend="rules",
+                                   shards="1") for t in samples)
+
+    # min-of-2 fresh-world runs per arm: the paced SLO run above already
+    # populated the roofline trace cache for these shapes, so both arms
+    # measure the steady-state loop; min() suppresses one-off GC/compile
+    # noise that would otherwise dominate at small event counts
+    wall_on = min(run(telemetry=True)[0] for _ in range(2))
+    wall_off = min(run(telemetry=False)[0] for _ in range(2))
+    overhead_pct = (wall_on - wall_off) / wall_off * 100.0
+    achieved = events / wall_slo
+
+    log(f"webhook_verdict_slo: p50 {p50*1e3:.1f} ms / p99 {p99*1e3:.1f} ms "
+        f"over {len(all_lat)} verdicts × {len(per_tenant)} tenants @ "
+        f"{achieved:.0f} ev/s (target {target_eps}); telemetry overhead "
+        f"{overhead_pct:+.2f}% (on {wall_on:.2f}s vs off {wall_off:.2f}s)")
+    return {
+        "metric": "webhook_verdict_slo",
+        "value": round(p99 * 1e3, 2),
+        "unit": f"ms p99 webhook→verdict @{target_eps} ev/s × "
+                f"{tenants} tenants",
+        "vs_baseline": round(0.25 / max(p99, 1e-9), 3),   # 250 ms budget
+        "p50_ms": round(p50 * 1e3, 2),
+        "p99_ms": round(p99 * 1e3, 2),
+        "per_tenant": per_tenant,
+        "verdicts": len(all_lat),
+        "tenants": tenants,
+        "events_per_sec_target": target_eps,
+        "events_per_sec_achieved": round(achieved, 1),
+        "paced": achieved <= target_eps * 1.05,
+        "histogram_p50_ms": round(hist_p50 * 1e3, 2),
+        "histogram_p99_ms": round(hist_p99 * 1e3, 2),
+        "telemetry_overhead_pct": round(overhead_pct, 3),
+        "telemetry_on_wall_s": round(wall_on, 3),
+        "telemetry_off_wall_s": round(wall_off, 3),
+        "platform": jax.default_backend(),
+    }
+
+
 def _sharded_tick_census(scorer) -> dict:
     """Modeled per-tick collective census of the EXACT tick the sharded
     scorer dispatches at its live shapes: trace the tick's jaxpr and run
@@ -1101,7 +1292,18 @@ def run_config(cfg: int, args) -> dict:
             "vs_baseline": 1.0,
         }
     if cfg == 4:
-        # pipelined-executor depth sweep first (graft-pipeline): overlap
+        # graft-scope SLO record first: p50/p99 webhook→verdict under
+        # 1k ev/s × 4 tenants with the telemetry on/off overhead measured
+        # (emits on CPU — the record shape is tier-1-guarded by
+        # tests/test_scope.py's hermetic smoke)
+        try:
+            print(json.dumps(bench_webhook_verdict_slo()), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_verdict_slo",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # pipelined-executor depth sweep (graft-pipeline): overlap
         # efficiency at depth 1/2/4 with depth parity asserted — emits on
         # CPU too, so the record is always present in the trajectory
         try:
@@ -1410,6 +1612,18 @@ def main(argv=None) -> int:
         except (Exception, SystemExit) as exc:
             print(json.dumps({
                 "metric": "serving_recovery",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # graft-scope smoke: the webhook→verdict SLO record shape at
+        # small shapes (the 1k ev/s × 4-tenant claim runs in config 4;
+        # overhead numbers are only meaningful at the full shapes)
+        try:
+            print(json.dumps(bench_webhook_verdict_slo(
+                num_pods=300, tenants=4, events=600, batch_size=60,
+                verbose=False)), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_verdict_slo",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
         return 0

@@ -99,6 +99,20 @@ class Settings:
     # --- observability ---
     metrics_enabled: bool = True
     tracing_enabled: bool = True
+    # graft-scope (observability/scope.py): per-tick serving telemetry —
+    # host-boundary stage timestamps on every tick (staging / coalesce /
+    # queue wait / dispatch / device completion / fetch), aggregated into
+    # the webhook→verdict SLO histograms and the flight recorder. All
+    # timestamping is host-side monotonic reads at the existing non-jitted
+    # boundaries: the jitted ticks are untouched (COST_BASELINE invariant)
+    # and the overhead contract is <1% of depth-2 steady-state throughput
+    # (tests/test_scope.py, marker perf_contract).
+    scope_telemetry: bool = True
+    # flight recorder: ring of the last K per-tick records, dumped to
+    # scope_flight_dir on every shield degradation transition or recovery
+    # ("" -> .kaeg_scope/<pid>)
+    scope_flight_records: int = 256
+    scope_flight_dir: str = ""
 
     # --- TPU-native knobs (new in this framework) ---
     # pipelined serving executor (rca/streaming.py): max ticks in flight

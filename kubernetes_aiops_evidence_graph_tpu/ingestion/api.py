@@ -27,9 +27,11 @@ from ..observability import (
     ALERTS_RECEIVED,
     INCIDENTS_CREATED,
     REGISTRY,
+    TRACER,
     WEBHOOK_LATENCY,
     get_logger,
 )
+from ..observability.scope import FLIGHT_RECORDER, SCOPE
 from ..storage import DuplicateIncidentError
 
 log = get_logger("api")
@@ -133,16 +135,23 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._json(400, {"error": "alerts must be a list of alert objects"})
             return
         created, duplicates = [], 0
-        for alert in alerts:
-            ALERTS_RECEIVED.inc(source="alertmanager")
-            if alert.get("status") != "firing":   # main.py:146-147
-                continue
-            spec = AlertNormalizer.normalize_alertmanager(alert)
-            incident_id = self.app.ingest(spec)
-            if incident_id is None:
-                duplicates += 1
-            else:
-                created.append(incident_id)
+        # graft-scope: the webhook span is the ROOT of the incident's
+        # trace — ServeScope carries its context to the async workflow
+        # (workflow/engine.py parents every step span under it) and
+        # stamps the arrival time the webhook→verdict SLO measures from
+        with TRACER.span("webhook.alertmanager", alerts=len(alerts)):
+            for alert in alerts:
+                ALERTS_RECEIVED.inc(source="alertmanager")
+                if alert.get("status") != "firing":   # main.py:146-147
+                    continue
+                spec = AlertNormalizer.normalize_alertmanager(alert)
+                incident_id = self.app.ingest(spec)
+                if incident_id is None:
+                    duplicates += 1
+                else:
+                    created.append(incident_id)
+                    SCOPE.webhook_received(
+                        incident_id, tenant=spec.namespace or "default")
         WEBHOOK_LATENCY.observe(time.perf_counter() - t0, endpoint="alertmanager")
         self._json(200, {"created": created, "duplicates": duplicates})
 
@@ -156,13 +165,16 @@ class ApiHandler(BaseHTTPRequestHandler):
             return
         payload = self._body()
         created, duplicates = [], 0
-        for spec in AlertNormalizer.normalize_grafana(payload):
-            ALERTS_RECEIVED.inc(source="grafana")
-            incident_id = self.app.ingest(spec)
-            if incident_id is None:
-                duplicates += 1
-            else:
-                created.append(incident_id)
+        with TRACER.span("webhook.grafana"):
+            for spec in AlertNormalizer.normalize_grafana(payload):
+                ALERTS_RECEIVED.inc(source="grafana")
+                incident_id = self.app.ingest(spec)
+                if incident_id is None:
+                    duplicates += 1
+                else:
+                    created.append(incident_id)
+                    SCOPE.webhook_received(
+                        incident_id, tenant=spec.namespace or "default")
         WEBHOOK_LATENCY.observe(time.perf_counter() - t0, endpoint="grafana")
         self._json(200, {"created": created, "duplicates": duplicates})
 
@@ -304,8 +316,17 @@ class ApiHandler(BaseHTTPRequestHandler):
 
     @route("GET", "/api/v1/traces")
     def traces(self):
-        from ..observability import TRACER
         self._json(200, {"spans": TRACER.export(self.query.get("trace_id"))})
+
+    @route("GET", "/api/v1/flight-recorder")
+    def flight_recorder(self):
+        """graft-scope forensics: the live per-tick flight ring plus the
+        last on-disk dump the shield froze (tier transition / recovery)."""
+        self._json(200, {
+            "records": FLIGHT_RECORDER.snapshot(),
+            "dumps": FLIGHT_RECORDER.dumps,
+            "last_dump_path": FLIGHT_RECORDER.last_dump_path,
+        })
 
     # -- workflow inspection (the Temporal-UI analog; reference
     # docker-compose.yml:80-92 ships Temporal UI so a human can watch an

@@ -71,7 +71,12 @@ class Histogram(_Metric):
         self._totals: dict[tuple, int] = defaultdict(int)
 
     def observe(self, value: float, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        self.observe_key(value, tuple(sorted(labels.items())))
+
+    def observe_key(self, value: float, key: tuple) -> None:
+        """Fast path for hot callers (graft-scope per-tick stages) that
+        pre-build the sorted label-tuple once instead of per observation.
+        ``key`` must be ``tuple(sorted(labels.items()))``."""
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, b in enumerate(self.buckets):
@@ -95,17 +100,30 @@ class Histogram(_Metric):
         return _Timer()
 
     def percentile(self, q: float, **labels) -> float:
-        """Approximate percentile from bucket counts (upper bound)."""
+        """Approximate percentile from bucket counts with linear
+        interpolation WITHIN the landing bucket (Prometheus
+        histogram_quantile semantics): the old upper-bound answer
+        overstated every quantile by up to a full bucket width, which at
+        the SLO bucket ladder turned a 30 ms p50 into 50 ms. Quantiles
+        beyond the last finite bucket clamp to its bound (there is no
+        width to interpolate into +Inf)."""
         key = tuple(sorted(labels.items()))
         total = self._totals.get(key, 0)
         if not total:
             return 0.0
         target = q * total
         counts = self._counts.get(key, [])
+        prev_cum = 0
         for i, c in enumerate(counts):
             if c >= target:
-                return self.buckets[i]
-        return float("inf")
+                lo = self.buckets[i - 1] if i else 0.0
+                in_bucket = c - prev_cum
+                if in_bucket <= 0:
+                    return lo
+                frac = (target - prev_cum) / in_bucket
+                return lo + frac * (self.buckets[i] - lo)
+            prev_cum = c
+        return self.buckets[-1] if self.buckets else 0.0
 
     def expose(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
@@ -243,3 +261,50 @@ SHIELD_NONFINITE_VERDICTS = REGISTRY.counter(
     "aiops_shield_nonfinite_verdicts_total",
     "Verdict fetches rejected by the finite guard (NaN/inf would have "
     "been served), by path label")
+
+# graft-scope instrumentation (observability/scope.py): the end-to-end
+# serving latency story — webhook→verdict SLO histograms, per-tick stage
+# splits at the host boundaries, telemetry self-accounting (dropped
+# spans), flight-recorder dumps, and roofline drift gauges.
+_SLO_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.15,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+WEBHOOK_VERDICT_LATENCY = REGISTRY.histogram(
+    "aiops_webhook_verdict_latency_seconds",
+    "End-to-end webhook→verdict latency by tenant/backend/shards — the "
+    "ROADMAP item-2 SLO surface (p50/p99 via Histogram.percentile)",
+    buckets=_SLO_BUCKETS)
+TICK_STAGE_SECONDS = REGISTRY.histogram(
+    "aiops_tick_stage_seconds",
+    "Per-tick host-boundary stage durations (staging|dispatch|"
+    "queue_wait|execute|fetch) by stage/backend labels",
+    buckets=_SLO_BUCKETS)
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "aiops_trace_spans_dropped_total",
+    "Spans silently evicted by a bounded telemetry buffer, by site "
+    "(tracer_ring | exporter_queue | scope_arrivals) — a tracer that "
+    "cannot count its own losses is not auditable")
+SCOPE_FLIGHT_DUMPS = REGISTRY.counter(
+    "aiops_scope_flight_dumps_total",
+    "Flight-recorder dumps written, by reason label (shield tier "
+    "transitions and recoveries)")
+SCOPE_VERDICTS_OBSERVED = REGISTRY.counter(
+    "aiops_scope_verdicts_observed_total",
+    "Webhook→verdict latency samples observed, by backend label")
+ROOFLINE_MODELED_BYTES = REGISTRY.gauge(
+    "aiops_roofline_modeled_tick_bytes",
+    "graft-cost modeled HBM bytes of the LIVE serving tick (traced at "
+    "its current compiled shapes), by entrypoint label")
+ROOFLINE_HALO_BYTES = REGISTRY.gauge(
+    "aiops_roofline_modeled_halo_bytes",
+    "graft-cost modeled collective (halo) bytes of the live serving "
+    "tick, by entrypoint label")
+ROOFLINE_ACHIEVED_BPS = REGISTRY.gauge(
+    "aiops_roofline_achieved_bytes_per_sec",
+    "Modeled tick bytes / host-observed device seconds (EWMA): the "
+    "achieved-bandwidth proxy the drift gauge compares against")
+ROOFLINE_DRIFT = REGISTRY.gauge(
+    "aiops_roofline_drift",
+    "Achieved bytes/sec vs the session's best observed for the same "
+    "entrypoint (1.0 = at the high-water mark; a sustained fall is "
+    "measured performance decaying away from the cost model without a "
+    "bench run)")

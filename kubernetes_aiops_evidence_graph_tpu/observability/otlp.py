@@ -64,20 +64,35 @@ class OtlpExporter:
         self._dropped = 0
         self._exported = 0
         self._flush_interval_s = flush_interval_s
+        self.tracer = None     # set by attach(); read by stats()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="kaeg-otlp-export")
         self._thread.start()
+
+    def attach(self, tracer) -> "OtlpExporter":
+        """Wire this exporter as the tracer's on-end hook and remember the
+        tracer so stats() can report ITS ring-buffer losses too — one
+        stats surface for every place the telemetry path can drop data."""
+        tracer.on_end = self.enqueue
+        self.tracer = tracer
+        return self
 
     # -- producer side ----------------------------------------------------
 
     def enqueue(self, span: Span) -> None:
         with self._lock:
             if len(self._queue) >= _MAX_QUEUE:
-                self._dropped += 1      # bounded queue: never grow unbounded
+                self._count_dropped(1)  # bounded queue: never grow unbounded
                 return                  # when the collector is down
             self._queue.append(span)
         if len(self._queue) >= _MAX_BATCH:
             self._wake.set()
+
+    def _count_dropped(self, n: int) -> None:
+        """Caller holds ``_lock``."""
+        self._dropped += n
+        from .metrics import TRACE_SPANS_DROPPED
+        TRACE_SPANS_DROPPED.inc(float(n), site="exporter_queue")
 
     # -- consumer side ----------------------------------------------------
 
@@ -116,20 +131,35 @@ class OtlpExporter:
                 self._exported += len(batch)
             return len(batch)
         except (OSError, http.client.HTTPException):
-            # dead/unreachable collector: drop the batch, never block or
-            # fail the traced path (export is best-effort by design)
+            # dead/unreachable collector: RETAIN the batch (front of the
+            # queue, original order) up to the bounded-queue cap so a
+            # transient outage loses nothing; beyond the cap the overflow
+            # is dropped and counted. Never block or fail the traced path
+            # (export stays best-effort); returning 0 is what stops the
+            # close() drain loop from spinning on a dead endpoint.
             with self._lock:
-                self._dropped += len(batch)
+                space = _MAX_QUEUE - len(self._queue)
+                keep = batch[:space] if space > 0 else []
+                self._queue[:0] = keep
+                if len(batch) > len(keep):
+                    self._count_dropped(len(batch) - len(keep))
             return 0
 
     def stats(self) -> dict:
         with self._lock:
             return {"queued": len(self._queue), "exported": self._exported,
-                    "dropped": self._dropped}
+                    "dropped": self._dropped,
+                    # the tracer's own ring-buffer evictions, when attached:
+                    # every loss site in the span path, one surface
+                    "tracer_dropped": getattr(self.tracer, "dropped", 0)}
 
     def close(self) -> None:
+        """Stop the flush thread and drain what a live collector will
+        take. Idempotent, and flush() stays safe to call afterwards (a
+        final manual flush after close is the shutdown idiom)."""
         self._stop = True
         self._wake.set()
-        self._thread.join(timeout=2)
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
         while self.flush():   # drain the whole backlog, not one batch
             pass

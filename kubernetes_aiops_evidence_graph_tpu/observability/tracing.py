@@ -52,6 +52,9 @@ class Tracer:
         self._lock = threading.Lock()
         self._max = max_spans
         self._tls = threading.local()
+        # spans silently evicted by the ring buffer — a tracer that loses
+        # data without counting it is not auditable (graft-scope)
+        self.dropped = 0
         # optional on-end hook (observability/otlp.OtlpExporter.enqueue);
         # must never raise into the traced code path
         self.on_end = None
@@ -61,12 +64,25 @@ class Tracer:
         return stack[-1] if stack else None
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes: Any) -> Iterator[Span]:
-        parent = self._current()
+    def span(self, name: str, parent: "Span | tuple | None" = None,
+             **attributes: Any) -> Iterator[Span]:
+        """Open a span. ``parent`` overrides the thread-local stack with an
+        explicit context — either a Span or a ``(trace_id, span_id)`` pair
+        — so a workflow resumed on another thread (or launched from a
+        webhook whose HTTP span is long closed) can still join its
+        originating trace (graft-scope context propagation)."""
+        if parent is None:
+            parent = self._current()
+        if isinstance(parent, tuple):
+            trace_id, parent_id = parent
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = uuid.uuid4().hex[:16], None
         s = Span(
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+            trace_id=trace_id,
             span_id=uuid.uuid4().hex[:16],
-            parent_id=parent.span_id if parent else None,
+            parent_id=parent_id,
             name=name,
             # graft-audit: allow[wall-clock] absolute epoch field for OTLP startTimeUnixNano; the duration uses start_mono
             start_s=time.time(),
@@ -89,15 +105,45 @@ class Tracer:
             # step mid-span
             s.end_s = s.start_s + (s.end_mono - s.start_mono)
             stack.pop()
-            with self._lock:
-                self._spans.append(s)
-                if len(self._spans) > self._max:
-                    self._spans = self._spans[-self._max:]
-            if self.on_end is not None:
-                try:
-                    self.on_end(s)
-                except Exception:  # graft-audit: allow[broad-except] telemetry hook must never break the traced path
-                    pass
+            self._record(s)
+
+    def _record(self, s: Span) -> None:
+        with self._lock:
+            self._spans.append(s)
+            if len(self._spans) > self._max:
+                evicted = len(self._spans) - self._max
+                self.dropped += evicted
+                from .metrics import TRACE_SPANS_DROPPED
+                TRACE_SPANS_DROPPED.inc(float(evicted), site="tracer_ring")
+                self._spans = self._spans[-self._max:]
+        if self.on_end is not None:
+            try:
+                self.on_end(s)
+            except Exception:  # graft-audit: allow[broad-except] telemetry hook must never break the traced path
+                pass
+
+    def emit(self, s: Span) -> None:
+        """Record a pre-timed span built outside the context-manager path
+        (graft-scope materializes a tick's stage spans retrospectively at
+        the fetch boundary — one emit per fetched tick, zero span objects
+        in the per-stage hot path)."""
+        self._record(s)
+
+    @contextlib.contextmanager
+    def attach(self, span: Span) -> Iterator[Span]:
+        """Push an ALREADY-OPEN span onto this thread's context stack
+        without re-timing or re-recording it: workflow steps run on
+        executor threads whose stack is empty, so without this every span
+        a step opens (collector spans, serving-tick spans) would start an
+        unrelated trace instead of parenting under the step."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+        try:
+            yield span
+        finally:
+            stack.pop()
 
     def export(self, trace_id: str | None = None) -> list[dict]:
         with self._lock:

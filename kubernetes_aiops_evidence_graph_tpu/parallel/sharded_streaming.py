@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..observability import scope as obs_scope
 from .compat import shard_map
 from .sharded_gnn import _ring_perm
 from .sharded_rules import evidence_fold_block
@@ -99,6 +100,10 @@ def route_node_delta(entries, nodes_per_shard: int, shards: int,
         g = int(e[0]) // nodes_per_shard
         per_shard[g].append(e)
     k = max((len(s) for s in per_shard), default=0)
+    # graft-scope: per-shard routing counts — the imbalance gauge (one
+    # hot shard sets the compiled delta width for every shard) and the
+    # shard_rows field of the next tick's flight record
+    obs_scope.note_route(len(s) for s in per_shard)
     pk = bucket_for(max(k, 1), buckets)
     idx = np.full((shards, pk), nodes_per_shard, np.int32)
     for g, ents in enumerate(per_shard):

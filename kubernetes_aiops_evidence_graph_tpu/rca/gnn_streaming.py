@@ -55,6 +55,7 @@ import jax.numpy as jnp
 
 from ..graph.store import EvidenceGraphStore
 from ..observability import get_logger
+from ..observability import scope as obs_scope
 from .ruleset import NUM_RULES
 from .streaming import StreamingScorer, _DELTA_BUCKETS
 from . import gnn
@@ -165,6 +166,9 @@ class GnnStreamingScorer(StreamingScorer):
         # change verdicts — only the lowering that produces them
         self._use_pallas = bool(getattr(cfg, "gnn_pallas", False))
         super().__init__(store, settings, mesh=mesh, now_s=now_s)
+        # graft-scope: this scorer's ticks and SLO samples are labeled by
+        # the backend that actually produced the verdict
+        self.scope.backend = "gnn"
 
     def _tick_statics(self, rel_offsets=None, slices_sorted=None) -> dict:
         """Static kwargs for _gnn_tick under the current mode. A fresh
@@ -624,28 +628,54 @@ class GnnStreamingScorer(StreamingScorer):
         (unfetched); GNN outputs land in `_last_gnn`."""
         aux_rows = list(self._pending_feat.keys())
         out = super().dispatch()
+        span = self._last_tick_span   # opened by the base dispatch
         self._drain_edges()
         if self._mirror_sharded:
             ints, pk, ek = self._packed_gnn_delta_sharded(aux_rows)
             tick = self._sharded_tick_fn(pk, ek)
+            args = (self._params, self._features_dev, self._kind_dev,
+                    self._nmask_dev, self._esrc_dev, self._edst_dev,
+                    self._erel_dev, self._emask_dev, jnp.asarray(ints))
+            self._scope_gnn(span, True, pk, ek, tick, args)
             (self._kind_dev, self._nmask_dev, self._esrc_dev,
              self._edst_dev, self._erel_dev, self._emask_dev, logits,
-             probs) = tick(
-                self._params, self._features_dev, self._kind_dev,
-                self._nmask_dev, self._esrc_dev, self._edst_dev,
-                self._erel_dev, self._emask_dev, jnp.asarray(ints))
+             probs) = tick(*args)
         else:
             ints, pk, ek = self._packed_gnn_delta(aux_rows)
+            statics = self._tick_statics()
+            args = (self._params, self._features_dev, self._kind_dev,
+                    self._nmask_dev, self._esrc_dev, self._edst_dev,
+                    self._erel_dev, self._emask_dev, jnp.asarray(ints))
+            self._scope_gnn(
+                span, False, pk, ek,
+                partial(_gnn_tick, pk=pk, ek=ek,
+                        pi=self.snapshot.padded_incidents, **statics),
+                args)
             (self._kind_dev, self._nmask_dev, self._esrc_dev,
              self._edst_dev, self._erel_dev, self._emask_dev, logits,
              probs) = _gnn_tick(
-                self._params, self._features_dev, self._kind_dev,
-                self._nmask_dev, self._esrc_dev, self._edst_dev,
-                self._erel_dev, self._emask_dev, jnp.asarray(ints),
-                pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
-                **self._tick_statics())
+                *args, pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
+                **statics)
         self._last_gnn = (logits, probs)
+        if span is not None:
+            span.mark("gnn_dispatch")
         return out
+
+    def _scope_gnn(self, span, sharded: bool, pk: int, ek: int,
+                   tick, args) -> None:
+        """Roofline-model the GNN tick at its live compiled shapes (cached
+        per shape key; abstract trace — the donated mirrors are not
+        consumed). The GNN tick supersedes the rules tick as the roofline
+        entrypoint this scorer reports: its verdict is the one served."""
+        if span is None:
+            return
+        self._scope_entry = ("streaming.gnn_tick.sharded" if sharded
+                             else "streaming.gnn_tick")
+        self._scope_key = (self.snapshot.padded_nodes,
+                           self.snapshot.padded_incidents,
+                           int(self._esrc_dev.shape[0]), pk, ek, sharded)
+        obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
+                                 tick, args)
 
     def rescore(self) -> dict:
         """GnnRcaBackend.score_snapshot-shaped raw dict for live incidents.
@@ -659,14 +689,25 @@ class GnnStreamingScorer(StreamingScorer):
                  "rebuilds": self.rebuilds,
                  "coalesced_ticks": self.coalesced_ticks,
                  "deferred_fetches": self.deferred_fetches}
+        queue_wait_s = self._drain_queue_wait()
         t1 = time.perf_counter()
         self.dispatch()
+        span, self._last_tick_span = self._last_tick_span, None
         self._supersede_inflight()
         dispatch_s = time.perf_counter() - t1
         t2 = time.perf_counter()
         self._fault_point("fetch")
+        if span is not None:
+            jax.block_until_ready(self._last_gnn[1])
+            span.mark("execute")
         probs = np.asarray(jax.device_get(self._last_gnn[1]))
         fetch_s = time.perf_counter() - t2
+        if span is not None:
+            span.mark("fetch")
+            exec_s = span.splits().get("execute", 0.0)
+            self.scope.finalize(span, fetched=True)
+            obs_scope.ROOFLINE.observe(self._scope_entry, self._scope_key,
+                                       exec_s)
         self.fetches += 1
         obs_metrics.SERVE_FETCHED_BYTES.inc(
             float(probs.nbytes), path="gnn_rescore")
@@ -679,9 +720,10 @@ class GnnStreamingScorer(StreamingScorer):
             "top_rule_index": pred,
             "any_match": pred != NUM_RULES,
             "top_confidence": p.max(axis=-1),
+            "queue_wait_seconds": queue_wait_s,
             "dispatch_seconds": dispatch_s,
             "fetch_seconds": fetch_s,
-            "device_seconds": dispatch_s + fetch_s,
+            "device_seconds": queue_wait_s + dispatch_s + fetch_s,
             **stats,
         }
 

@@ -67,6 +67,7 @@ import jax.numpy as jnp
 from ..config import Settings, get_settings
 from ..observability import get_logger
 from ..observability import metrics as obs_metrics
+from ..observability import scope as obs_scope
 from ..workflow.engine import NonRetryableError, RetryPolicy
 from .journal import DeltaJournal
 from .streaming import NonFiniteDelta
@@ -149,6 +150,11 @@ class ShieldedScorer:
         scorer.finite_delta_guard = True
         d = directory or self.settings.shield_dir or os.path.join(
             ".kaeg_shield", str(os.getpid()))
+        # flight-recorder dumps land next to the journal they explain
+        # (unless the operator routed them elsewhere): recovery forensics
+        # and recovery state travel together
+        self.flight_dir = (getattr(self.settings, "scope_flight_dir", "")
+                           or os.path.join(d, "flight"))
         self.journal = DeltaJournal(
             d, fault_hook=injector.journal_hook if injector else None,
             fsync_every=getattr(self.settings,
@@ -321,6 +327,7 @@ class ShieldedScorer:
             self._watchdog(time.perf_counter() - t0)
             if state["failures"] and self.tier != "rules_fallback":
                 self.tier = "steady"
+                self.scorer._scope_tier = "steady"
             return out
 
     def _escalate(self, exc: Exception, state: dict) -> None:
@@ -334,6 +341,13 @@ class ShieldedScorer:
         log.warning("guarded_tick_failed", stage=stage or "unknown",
                     error=str(exc), failures=state["failures"],
                     suspect=suspect)
+        # forensic interleave: the failure lands in the flight ring at
+        # its arrival order, so a dump shows WHICH tick records surround
+        # the fault and what the pipeline looked like when it hit
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "guarded_tick_failed", stage=stage or "unknown",
+            error=f"{type(exc).__name__}: {str(exc)[:200]}",
+            failures=state["failures"], suspect=suspect)
         if isinstance(exc, (NonFiniteVerdict, NonFiniteDelta)):
             # NonFiniteDelta: poison caught at the dispatch boundary
             # before the scatter; NonFiniteVerdict: the backstop at the
@@ -343,6 +357,9 @@ class ShieldedScorer:
             self.journal.mark_quarantined(lo, hi, reason=str(exc))
             self.quarantined_batches += 1
             obs_metrics.SHIELD_QUARANTINED_DELTAS.inc()
+            obs_scope.FLIGHT_RECORDER.note_event(
+                "quarantined", seq_lo=lo, seq_hi=hi,
+                reason=str(exc)[:200])
         if not suspect and state["failures"] <= self.retry.max_attempts:
             # transient, state coherent: bounded retry with seeded-jitter
             # backoff (key = store lineage + batch, so concurrent shields
@@ -464,6 +481,11 @@ class ShieldedScorer:
         self.tier = tier
         self.tier_log.append(tier)
         obs_metrics.SHIELD_TIER_TRANSITIONS.inc(tier=tier)
+        # graft-scope: stamp the tier onto the scorer (every subsequent
+        # TickSpan carries it) and freeze the flight ring to disk — the
+        # forensic window AROUND the degradation, not just its counter
+        self.scorer._scope_tier = tier
+        obs_scope.FLIGHT_RECORDER.dump(f"tier:{tier}", self.flight_dir)
 
     # -- snapshots + recovery ---------------------------------------------
 
@@ -542,6 +564,8 @@ class ShieldedScorer:
             self._ticks_since_snapshot = self.snapshot_every
             obs_metrics.SHIELD_RECOVERIES.inc(mode="full_rebuild")
             log.warning("recovered_via_rebuild", seconds=round(dt, 4))
+            obs_scope.FLIGHT_RECORDER.dump("recovery:full_rebuild",
+                                           self.flight_dir)
             return {"mode": "full_rebuild", "replayed": 0, "seconds": dt}
         replayed = 0
         with s.serve_lock:
@@ -572,6 +596,8 @@ class ShieldedScorer:
         obs_metrics.SHIELD_RECOVERIES.inc(mode="journal_replay")
         log.warning("recovered_via_journal_replay", replayed=replayed,
                     torn_truncated=torn, seconds=round(dt, 4))
+        obs_scope.FLIGHT_RECORDER.dump("recovery:journal_replay",
+                                       self.flight_dir)
         return {"mode": "journal_replay", "replayed": replayed,
                 "torn_truncated": torn, "seconds": dt}
 

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from ..config import Settings, get_settings
 from ..observability import get_logger
 from ..observability import metrics as obs_metrics
+from ..observability import scope as obs_scope
 from ..graph.schema import EntityKind, RelationKind
 from ..graph.snapshot import GraphSnapshot, build_snapshot, extract_node_features
 from ..graph.store import EvidenceGraphStore
@@ -237,6 +238,22 @@ class StreamingScorer:
         self.coalesced_ticks = 0
         self.deferred_fetches = 0
         self.stall_seconds = 0.0
+        # graft-scope: per-tick telemetry front-end. The hot path pays one
+        # attribute read per boundary when disabled; enabled it records
+        # host-monotonic stage marks only — no device syncs the serving
+        # path would not already pay, no jitted code touched.
+        # _inflight_meta shadows _inflight one TickSpan per queued tick
+        # (None when telemetry is off) so device completion is stamped at
+        # the moment the HOST first observes the donated tick's ready
+        # event — retire, stall, or fetch, whichever comes first.
+        self.scope = obs_scope.TickScope(backend="rules",
+                                         settings=self.settings)
+        self._scope_tier = "steady"        # the shield re-stamps on ladder moves
+        self._inflight_meta: collections.deque = collections.deque()
+        self._last_tick_span = None
+        self._scope_coalesced_since = 0
+        self._scope_key: tuple = ()
+        self._scope_entry = "streaming.rules_tick"
         # coalesced-serving state (see serve()): one device pass satisfies
         # every caller whose store writes preceded that pass's sync
         self._serve_cv = threading.Condition()
@@ -268,6 +285,11 @@ class StreamingScorer:
             self.deferred_fetches += len(stale)
             obs_metrics.SERVE_DEFERRED_FETCHES.inc(float(len(stale)))
             stale.clear()
+        stale_meta = getattr(self, "_inflight_meta", None)
+        if stale_meta:
+            for sp in stale_meta:
+                self.scope.finalize(sp)
+            stale_meta.clear()
         # capture the journal cursor BEFORE tensorizing: mutations landing
         # in between are both in the snapshot and replayed by the next
         # sync(), and every mirror op is an idempotent MERGE, so replays
@@ -1209,7 +1231,27 @@ class StreamingScorer:
     def dispatch(self) -> tuple:
         """Flush pending deltas and enqueue one scoring pass; returns the
         device result handles without a host fetch (the dev tunnel charges
-        ~75 ms per synchronous fetch — see tpu_backend.dispatch)."""
+        ~75 ms per synchronous fetch — see tpu_backend.dispatch).
+
+        graft-scope: the tick's TickSpan is opened here and stamped at
+        each host boundary — ``staging`` when the packed deltas are
+        ready, ``dispatch`` when the jit enqueue returns. The span parks
+        in ``_last_tick_span`` for the caller (tick_async queues it with
+        the in-flight handles; rescore finalizes it at the fetch)."""
+        if self._last_tick_span is not None:
+            # the previous tick aborted between dispatch and its caller
+            # boundary (an injected fault, a device error): record it
+            # rather than silently overwrite — faulted ticks are exactly
+            # what the flight recorder exists to explain
+            self._last_tick_span.flag("abandoned")
+            self.scope.finalize(self._last_tick_span)
+            self._last_tick_span = None
+        span = self.scope.begin(self)
+        self._last_tick_span = span
+        if span is not None:
+            span.pending = len(self._pending_feat) + len(self._dirty_rows)
+            span.coalesced = self._scope_coalesced_since
+            self._scope_coalesced_since = 0
         sharded = self._graph_sharded(self.snapshot.padded_nodes,
                                       self.snapshot.padded_incidents)
         if sharded:
@@ -1226,6 +1268,10 @@ class StreamingScorer:
         if self.finite_delta_guard and not np.isfinite(f_rows).all():
             # O(delta) host check, not O(N): quarantine-grade poison is
             # caught BEFORE it scatters into the donated state
+            if span is not None:
+                span.flag("nonfinite_delta")
+                self.scope.finalize(span)
+                self._last_tick_span = None
             raise NonFiniteDelta(
                 f"{int((~np.isfinite(f_rows)).any(axis=-1).sum())} "
                 "non-finite staged feature rows")
@@ -1238,18 +1284,38 @@ class StreamingScorer:
                              self.snapshot.padded_incidents,
                              self.width, self.pair_width,
                              pk=f_idx.shape[-1], rk=len(r_idx))
-        out = tick(
-            self._features_dev, jnp.asarray(ints), jnp.asarray(f_rows),
-            self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
-            self._chain0,
-        )
+        ints_dev = jnp.asarray(ints)
+        rows_dev = jnp.asarray(f_rows)
+        args = (self._features_dev, ints_dev, rows_dev,
+                self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
+                self._chain0)
+        if span is not None:
+            span.mark("staging")
+            # roofline drift: price THIS tick's jaxpr with the graft-cost
+            # model, cached per compiled shape key (make_jaxpr is
+            # abstract — it neither executes nor consumes the donated
+            # buffers, and re-traces exactly when XLA itself recompiles)
+            self._scope_key = (self.snapshot.padded_nodes,
+                               self.snapshot.padded_incidents,
+                               self.width, self.pair_width,
+                               f_idx.shape[-1], len(r_idx), sharded)
+            self._scope_entry = self._scope_entrypoint(sharded)
+            obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
+                                     tick, args)
+        out = tick(*args)
         (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
          self._pair_dev) = out[:4]
         # device error / preemption mid-pipeline: the donated inputs are
         # already dead and the outputs may be poisoned — the shield's
         # recovery tiers are the only way back to the pre-fault state
         self._fault_point("execute")
+        if span is not None:
+            span.mark("dispatch")
         return out[4:]
+
+    def _scope_entrypoint(self, sharded: bool) -> str:
+        return ("streaming.rules_tick.sharded" if sharded
+                else "streaming.rules_tick")
 
     # -- graft-shield seams (fault injection + snapshot/restore) -----------
 
@@ -1307,6 +1373,7 @@ class StreamingScorer:
         for k in self._HOST_STATE_ATTRS:
             setattr(self, k, state[k])
         self._inflight.clear()
+        self._inflight_meta.clear()
 
     def _resident_arrays(self) -> list:
         """The device-resident buffers a snapshot packs, in a fixed order
@@ -1366,15 +1433,28 @@ class StreamingScorer:
     def _retire_ready(self) -> None:
         """Pop completed ticks off the head of the in-flight queue. Their
         results are superseded without ever being fetched — exactly the
-        per-tick readback the deferred-fetch boundary exists to avoid."""
+        per-tick readback the deferred-fetch boundary exists to avoid.
+        Retirement is also where the host first OBSERVES a queued tick's
+        device completion (the donated tick's ready event), so its
+        TickSpan gets its ``execute`` stamp here — a host boundary, not
+        an injected sync."""
         n0 = len(self._inflight)
         while self._inflight and self._tick_ready(self._inflight[0]):
             self._inflight.popleft()
+            self._retire_meta(mark_execute=True)
             self.deferred_fetches += 1
         if n0 != len(self._inflight):
             obs_metrics.SERVE_DEFERRED_FETCHES.inc(
                 float(n0 - len(self._inflight)))
         obs_metrics.SERVE_PIPELINE_INFLIGHT.set(float(len(self._inflight)))
+
+    def _retire_meta(self, mark_execute: bool = False) -> None:
+        if not self._inflight_meta:
+            return
+        sp = self._inflight_meta.popleft()
+        if sp is not None and mark_execute:
+            sp.mark("execute")
+        self.scope.finalize(sp)
 
     def _pending_delta_count(self) -> int:
         """Host-side delta entries a coalesced tick would carry, as the
@@ -1397,6 +1477,8 @@ class StreamingScorer:
                 pending = self._pending_delta_count()
                 if pending < self._coalesce_bound:
                     self.coalesced_ticks += 1
+                    self._scope_coalesced_since += 1
+                    self.scope.note_coalesced(pending)
                     obs_metrics.SERVE_COALESCED_TICKS.inc()
                     obs_metrics.SERVE_COALESCED_TICK_SIZE.set(float(pending))
                     return {"dispatched": False, "coalesced": True,
@@ -1408,10 +1490,17 @@ class StreamingScorer:
                 stall = time.perf_counter() - t0
                 self.stall_seconds += stall
                 self.deferred_fetches += 1
+                # the stall is queue pressure charged to the tick about
+                # to dispatch; the drained tick's completion was just
+                # host-observed, so stamp its execute boundary
+                self.scope.note_queue_wait(stall)
+                self._retire_meta(mark_execute=True)
                 obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(stall)
                 obs_metrics.SERVE_DEFERRED_FETCHES.inc()
             out = self.dispatch()
             self._inflight.append(self._tick_handles(out))
+            self._inflight_meta.append(self._last_tick_span)
+            self._last_tick_span = None
             obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
                 float(len(self._inflight)))
             return {"dispatched": True, "coalesced": False,
@@ -1426,6 +1515,8 @@ class StreamingScorer:
             obs_metrics.SERVE_DEFERRED_FETCHES.inc(
                 float(len(self._inflight)))
             self._inflight.clear()
+        while self._inflight_meta:
+            self._retire_meta()
         obs_metrics.SERVE_PIPELINE_INFLIGHT.set(0.0)
 
     def serve(self) -> dict:
@@ -1480,28 +1571,63 @@ class StreamingScorer:
         pairs = sorted((r, iid) for iid, r in self._inc_row_of.items())
         return [p[1] for p in pairs], [p[0] for p in pairs]
 
+    def _drain_queue_wait(self) -> float:
+        """Pre-dispatch drain of a FULL pipeline: the caller-boundary tick
+        is about to dispatch behind ``depth`` unfinished ticks, and PR 5's
+        split charged that wait into ``dispatch_seconds`` (and, once the
+        device queue drained under the fetch, again into
+        ``fetch_seconds``). Waiting for the oldest slot here — read-only,
+        the total wall is unchanged — moves the wait into its own
+        ``queue_wait_seconds`` bucket so neither window double-counts
+        queue pressure. Returns the seconds waited (0.0 with a free
+        slot)."""
+        if len(self._inflight) < self.pipeline_depth:
+            return 0.0
+        t0 = time.perf_counter()
+        jax.block_until_ready(self._inflight[0][-1])
+        qw = time.perf_counter() - t0
+        self.scope.note_queue_wait(qw)
+        return qw
+
     def rescore(self) -> dict:
         """Caller-boundary tick + fetch. The dispatched tick reflects every
         pending delta (including ones coalesced by a full pipeline), so its
         result supersedes the whole in-flight queue — older results are
         dropped without a readback and exactly ONE device_get runs here.
-        ``dispatch_seconds`` is host packing + enqueue (the part pipelining
-        overlaps with device execution); ``fetch_seconds`` is the blocking
-        device wait + device->host readback; ``device_seconds`` keeps the
-        old conflated sum for back-compat consumers."""
+        ``queue_wait_seconds`` is time blocked behind a full pipeline
+        (see _drain_queue_wait); ``dispatch_seconds`` is host packing +
+        enqueue (the part pipelining overlaps with device execution);
+        ``fetch_seconds`` is the blocking device wait + device->host
+        readback; ``device_seconds`` keeps the back-compat total — the
+        sum of all three, the same window the old conflated split
+        covered."""
         stats = {"feature_updates": len(self._pending_feat),
                  "structural_refresh": bool(self._dirty_rows),
                  "rebuilds": self.rebuilds,
                  "coalesced_ticks": self.coalesced_ticks,
                  "deferred_fetches": self.deferred_fetches}
+        queue_wait_s = self._drain_queue_wait()
         t1 = time.perf_counter()
         out = self.dispatch()
+        span, self._last_tick_span = self._last_tick_span, None
         self._supersede_inflight()
         dispatch_s = time.perf_counter() - t1
         t2 = time.perf_counter()
         self._fault_point("fetch")
+        if span is not None:
+            # the block is the fetch's own device wait made explicit (a
+            # host boundary the device_get below would cross anyway):
+            # splits the span's execute window from the readback
+            jax.block_until_ready(out)
+            span.mark("execute")
         fetched = jax.device_get(out)
         fetch_s = time.perf_counter() - t2
+        if span is not None:
+            span.mark("fetch")
+            exec_s = span.splits().get("execute", 0.0)
+            self.scope.finalize(span, fetched=True)
+            obs_scope.ROOFLINE.observe(self._scope_entry, self._scope_key,
+                                       exec_s)
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
             fetched)
         self.fetches += 1
@@ -1517,8 +1643,9 @@ class StreamingScorer:
             "any_match": any_match[rows],
             "top_confidence": top_conf[rows],
             "top_score": top_score[rows],
+            "queue_wait_seconds": queue_wait_s,
             "dispatch_seconds": dispatch_s,
             "fetch_seconds": fetch_s,
-            "device_seconds": dispatch_s + fetch_s,
+            "device_seconds": queue_wait_s + dispatch_s + fetch_s,
             **stats,
         }

@@ -468,6 +468,18 @@ class TpuRcaBackend:
         obs_metrics.SERVE_FETCHED_BYTES.inc(
             float(sum(a.nbytes for a in fetched)), path="score_snapshot")
 
+        # graft-scope: when the caller carries a live trace (the workflow
+        # snapshot-verdict path), the scoring pass joins it as a child
+        # span with its dispatch/fetch splits — so the non-streaming
+        # verdict path shows up in the same webhook→verdict trace anatomy
+        # as the resident tick. Emitted retrospectively: zero span
+        # objects in the timed windows above.
+        from ..observability import scope as obs_scope
+        obs_scope.emit_stage_span(
+            "serve.score_snapshot",
+            (("dispatch", dispatch_s), ("fetch", fetch_s)),
+            fields=fields, incidents=snapshot.num_incidents)
+
         # finite guard (graft-shield): a poisoned feature row or device
         # fault must never surface as a NaN/inf verdict — count and log so
         # the snapshot path shares the serving path's honesty bar (the
@@ -480,6 +492,9 @@ class TpuRcaBackend:
                     path="score_snapshot")
                 get_logger("tpu_backend").warning(
                     "nonfinite_verdict_field", field=k)
+                obs_scope.FLIGHT_RECORDER.note_event(
+                    "nonfinite_verdict_field", field=k,
+                    path="score_snapshot")
                 break
 
         n = snapshot.num_incidents

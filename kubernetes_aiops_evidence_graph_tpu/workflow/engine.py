@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Sequence
 
 from ..observability import WORKFLOW_STEP_DURATION, WORKFLOW_STEPS, TRACER, get_logger
+from ..observability.scope import SCOPE
 from ..storage import Database
 
 log = get_logger("workflow")
@@ -119,14 +120,27 @@ class WorkflowEngine:
                                 attempts=attempts)
             t0 = time.perf_counter()
             try:
-                with TRACER.span(f"workflow.{step.name}", workflow=workflow_id):
+                # graft-scope context propagation: the step span joins the
+                # webhook's trace when this workflow's incident arrived
+                # through one (ServeScope carries the webhook span context
+                # across the async worker hop), so one exported trace
+                # shows webhook → evidence → tick → verdict. Sync steps
+                # run on executor threads whose span stack is empty —
+                # attach() re-parents everything the step itself opens
+                # (collector spans, serving-tick spans) under the step.
+                with TRACER.span(f"workflow.{step.name}",
+                                 parent=SCOPE.trace_parent(workflow_id),
+                                 workflow=workflow_id) as step_span:
                     if inspect.iscoroutinefunction(step.fn):
                         result = await asyncio.wait_for(
                             step.fn(ctx), timeout=step.timeout_s)
                     else:
+                        def _run_attached(fn=step.fn, span=step_span):
+                            with TRACER.attach(span):
+                                return fn(ctx)
                         result = await asyncio.wait_for(
                             asyncio.get_event_loop().run_in_executor(
-                                None, step.fn, ctx),
+                                None, _run_attached),
                             timeout=step.timeout_s)
                 json.dumps(result, default=str)  # journal-serializable check
                 dt = time.perf_counter() - t0

@@ -221,6 +221,13 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
     if llm.enabled:
         hyps = llm.enhance_hypotheses(ctx.incident, hyps, ctx.evidence_dicts)
     ctx.hypotheses = hyps
+    # graft-scope SLO boundary: the hypotheses ARE the verdict — close
+    # the webhook→verdict latency sample this incident opened at the
+    # ingestion edge (no-op for incidents that never passed a webhook)
+    from ..observability.scope import SCOPE
+    SCOPE.verdict_served(
+        str(ctx.incident.id), backend=backend_name,
+        shards=int(getattr(ctx.settings, "serve_graph_shards", 1)))
     RCA_DURATION.observe(_t.perf_counter() - t0, backend=backend_name)
     for h in hyps:
         HYPOTHESES_GENERATED.inc(category=getattr(h.category, "value", str(h.category)))

@@ -16,3 +16,31 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"
+
+
+# -- graft-scope failure forensics ------------------------------------------
+# On any failed session, freeze the in-process telemetry to .kaeg_debug/ so
+# CI can upload it as an artifact: the /metrics snapshot and the flight
+# recorder's per-tick ring are exactly the state a red tier-1 run needs
+# explained. Never let the dump itself mask the real failure.
+
+def pytest_sessionfinish(session, exitstatus):
+    if exitstatus == 0:
+        return
+    try:
+        import json
+        import os
+
+        from kubernetes_aiops_evidence_graph_tpu.observability import REGISTRY
+        from kubernetes_aiops_evidence_graph_tpu.observability.scope import (
+            FLIGHT_RECORDER)
+        os.makedirs(".kaeg_debug", exist_ok=True)
+        with open(".kaeg_debug/metrics_snapshot.prom", "w") as f:
+            f.write(REGISTRY.expose())
+        with open(".kaeg_debug/flight_recorder.json", "w") as f:
+            json.dump({"records": FLIGHT_RECORDER.snapshot(),
+                       "dumps": FLIGHT_RECORDER.dumps,
+                       "last_dump_path": FLIGHT_RECORDER.last_dump_path},
+                      f, indent=1)
+    except Exception:
+        pass

@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import threading
 
+import pytest
+
 from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
     Counter, Gauge, Histogram, REGISTRY, Registry,
 )
@@ -76,12 +78,38 @@ class TestHistogram:
             pass
         assert h._totals[(("step", "collect"),)] == 1
 
-    def test_percentile_upper_bound(self):
-        h = Histogram("p_seconds", buckets=(0.1, 1.0, 10.0))
+    def test_percentile_interpolates_within_bucket(self):
+        """graft-scope satellite: percentile() interpolates linearly
+        inside the landing bucket instead of returning its upper bound —
+        pinned against exact quantiles of a known uniform sample."""
+        h = Histogram("p_seconds",
+                      buckets=tuple(round(0.1 * k, 1) for k in range(1, 11)))
+        sample = [k / 1000.0 for k in range(1, 1001)]   # uniform (0, 1]
+        for v in sample:
+            h.observe(v)
+        import numpy as np
+        # within one bucket width of the exact quantile, and exact where
+        # the sample is uniform (the interpolation premise)
+        assert h.percentile(0.5) == pytest.approx(
+            float(np.percentile(sample, 50)), abs=0.005)
+        assert h.percentile(0.99) == pytest.approx(
+            float(np.percentile(sample, 99)), abs=0.005)
+
+    def test_percentile_not_bucket_upper_bound_regression(self):
+        """The old behavior returned the bucket's UPPER bound: 99 samples
+        at 0.05 put p50 at 0.1 (2× overstated). Interpolated, p50 lands
+        inside the first bucket; mass beyond the last finite bucket
+        clamps to that bound (no width to interpolate into +Inf)."""
+        h = Histogram("p2_seconds", buckets=(0.1, 1.0, 10.0))
         for _ in range(99):
             h.observe(0.05)
         h.observe(5.0)
-        assert h.percentile(0.5) == 0.1
+        p50 = h.percentile(0.5)
+        assert p50 == pytest.approx(0.1 * (50 / 99), rel=1e-6)
+        assert p50 < 0.1
+        assert h.percentile(1.0) == 10.0
+        # overflow mass (beyond every finite bucket) clamps too
+        h.observe(50.0)
         assert h.percentile(1.0) == 10.0
         assert Histogram("empty").percentile(0.5) == 0.0
 
@@ -142,13 +170,55 @@ class TestTracer:
         tr.clear()
         assert tr.export() == []
 
-    def test_ring_buffer_caps_spans(self):
+    def test_ring_buffer_caps_spans_and_counts_drops(self):
+        """graft-scope satellite: eviction past max_spans is COUNTED —
+        on the tracer itself and in aiops_trace_spans_dropped_total."""
+        from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
+            TRACE_SPANS_DROPPED)
+        before = TRACE_SPANS_DROPPED.value(site="tracer_ring")
         tr = Tracer(max_spans=4)
         for i in range(10):
             with tr.span(f"s{i}"):
                 pass
         names = [s["name"] for s in tr.export()]
         assert names == ["s6", "s7", "s8", "s9"]
+        assert tr.dropped == 6
+        assert TRACE_SPANS_DROPPED.value(site="tracer_ring") == before + 6
+
+    def test_explicit_parent_joins_foreign_trace(self):
+        """span(parent=(trace_id, span_id)) joins a trace whose opening
+        span is long closed — the graft-scope webhook→workflow hop."""
+        tr = Tracer()
+        with tr.span("webhook") as root:
+            pass
+        with tr.span("workflow.step", parent=(root.trace_id, root.span_id)):
+            pass
+        spans = {s["name"]: s for s in tr.export()}
+        assert spans["workflow.step"]["trace_id"] == root.trace_id
+        assert spans["workflow.step"]["parent_id"] == root.span_id
+
+    def test_attach_reparents_executor_thread_spans(self):
+        """attach() pushes an open span onto ANOTHER thread's stack so
+        spans opened there parent under it instead of starting a fresh
+        trace (workflow steps run on executor threads)."""
+        tr = Tracer()
+        done = threading.Event()
+
+        def worker(span):
+            with tr.attach(span):
+                with tr.span("collector.kubernetes"):
+                    pass
+            done.set()
+
+        with tr.span("workflow.collect") as step:
+            t = threading.Thread(target=worker, args=(step,))
+            t.start()
+            done.wait(5)
+            t.join(5)
+        spans = {s["name"]: s for s in tr.export()}
+        child = spans["collector.kubernetes"]
+        assert child["trace_id"] == step.trace_id
+        assert child["parent_id"] == step.span_id
 
 
 class TestLogging:
@@ -257,18 +327,96 @@ def test_otlp_exporter_ships_spans():
             "value": {"stringValue": "kaeg-test"}} in res
 
 
-def test_otlp_exporter_survives_dead_collector():
-    """Export is best-effort: no collector listening -> spans dropped,
-    bounded queue, zero raise into the traced path."""
+def test_otlp_dead_collector_retains_up_to_cap_then_counts_drops(monkeypatch):
+    """graft-scope satellite: a failed POST RETAINS the batch (a
+    transient Tempo outage loses nothing) up to the bounded-queue cap;
+    beyond the cap the overflow is dropped and counted — on the exporter
+    AND in aiops_trace_spans_dropped_total. Never raises into the traced
+    path."""
+    from kubernetes_aiops_evidence_graph_tpu.observability import otlp
+    from kubernetes_aiops_evidence_graph_tpu.observability.tracing import Tracer
+
+    monkeypatch.setattr(otlp, "_MAX_QUEUE", 3)
+    tracer = Tracer()
+    exporter = otlp.OtlpExporter("http://127.0.0.1:9", flush_interval_s=60)
+    exporter.attach(tracer)   # satellite: stats() sees the tracer too
+    assert tracer.on_end == exporter.enqueue
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            pass
+    st = exporter.stats()
+    # cap applies at enqueue: 3 retained, 2 counted-dropped
+    assert st["queued"] == 3 and st["dropped"] == 2
+    # dead endpoint: the batch fails to ship and is RE-QUEUED, not lost
+    assert exporter.flush() == 0
+    st = exporter.stats()
+    assert st["queued"] == 3 and st["dropped"] == 2
+    assert st["exported"] == 0
+    assert st["tracer_dropped"] == tracer.dropped == 0
+    exporter.close()
+
+
+def test_otlp_flush_after_close_still_ships():
+    """close() stops the daemon flusher but the exporter object stays
+    usable: a manual flush afterwards ships to a live collector (the
+    shutdown idiom is close() then one final flush)."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
     from kubernetes_aiops_evidence_graph_tpu.observability.otlp import OtlpExporter
     from kubernetes_aiops_evidence_graph_tpu.observability.tracing import Tracer
 
-    tracer = Tracer()
-    exporter = OtlpExporter("http://127.0.0.1:9", flush_interval_s=60)
-    tracer.on_end = exporter.enqueue
-    with tracer.span("doomed"):
-        pass
-    assert exporter.flush() == 0
-    st = exporter.stats()
-    assert st["dropped"] == 1 and st["queued"] == 0
-    exporter.close()
+    received: list[dict] = []
+
+    class _Collector(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            received.append(json.loads(
+                self.rfile.read(int(self.headers["Content-Length"]))))
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Collector)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        tracer = Tracer()
+        exporter = OtlpExporter(
+            f"http://127.0.0.1:{srv.server_address[1]}",
+            flush_interval_s=60).attach(tracer)
+        exporter.close()          # idempotent; flusher stopped
+        exporter.close()
+        with tracer.span("late"):
+            pass                  # on_end still enqueues post-close
+        assert exporter.flush() == 1
+        assert exporter.stats()["exported"] == 1
+    finally:
+        srv.shutdown()
+    assert received and received[0]["resourceSpans"]
+
+
+def test_otlp_span_id_padding_round_trip():
+    """span_to_otlp pads the tracer's 16-hex trace ids to OTLP's 32-hex
+    width: the original id survives a round trip (strip the zero pad),
+    and over-long ids truncate to the OTLP width instead of shipping
+    malformed JSON."""
+    from kubernetes_aiops_evidence_graph_tpu.observability.otlp import span_to_otlp
+    from kubernetes_aiops_evidence_graph_tpu.observability.tracing import Span
+
+    s = Span(trace_id="abc123", span_id="f00d", parent_id="beef",
+             name="x", start_s=1.0, end_s=2.0)
+    o = span_to_otlp(s)
+    assert len(o["traceId"]) == 32 and len(o["spanId"]) == 16
+    assert len(o["parentSpanId"]) == 16
+    # round trip: strip the zfill pad, recover the original ids
+    assert o["traceId"].lstrip("0") == "abc123"
+    assert o["spanId"].lstrip("0") == "f00d"
+    assert o["parentSpanId"].lstrip("0") == "beef"
+    long = Span(trace_id="a" * 40, span_id="b" * 20, parent_id=None,
+                name="y", start_s=1.0, end_s=2.0)
+    lo = span_to_otlp(long)
+    assert len(lo["traceId"]) == 32 and len(lo["spanId"]) == 16
+    assert "parentSpanId" not in lo
