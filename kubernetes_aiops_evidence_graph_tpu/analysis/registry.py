@@ -232,6 +232,39 @@ def _rules_tick_coalesced_build():
     return _rules_tick_build(pk=_DELTA_BUCKETS[-1], rk=_ROW_BUCKETS[-1])
 
 
+# graft-surge canonical pack: TENANTS regions of the canonical streaming
+# shapes packed onto one resident state — the multi-tenant tick is the
+# SAME jitted _tick at the summed region shapes (pn·T node rows, pi·T
+# incident rows scored in ONE pass), so its cost must scale exactly
+# linearly in T with zero new collectives
+SURGE_TENANTS = 4
+
+
+def _rules_tick_multitenant_build():
+    """graft-surge: the packed cross-tenant rules tick — SURGE_TENANTS
+    tenant regions (4096 node rows / 32 incident rows each, the
+    streaming canonical shapes) in one resident state; every tenant's
+    live incidents score in one device pass. Reuses streaming._tick
+    (donation contract and all); this entry pins the packed shapes in
+    the ratchet so tenant-packing can never quietly change the
+    per-incident cost envelope."""
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.streaming import _tick
+    t = SURGE_TENANTS
+    pn, pi, width, pair_width = 4096 * t, 32 * t, 128, 16
+    pk, rk = 64, 4
+    ints = np.zeros(pk + 2 * rk + 2 * rk * width, np.int32)
+    fn = partial(_tick, padded_incidents=pi, pair_width=pair_width,
+                 pk=pk, rk=rk, width=width)
+    args = (np.zeros((pn, DIM), np.float32), ints,
+            np.zeros((pk, DIM), np.float32),
+            np.zeros((pi, width), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, width), pair_width, np.int32),
+            np.zeros(pi, np.float32))
+    return fn, args
+
+
 def _gnn_tick_build(pk: int = 64, ek: int = 256):
     np = _np()
     from ..graph.schema import DIM
@@ -562,6 +595,15 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
         notes="worst coalesced GNN tick (aux+edge deltas at the ladder "
               "top); explicit zero-collective CostSpec — the serving tick "
               "may never go distributed implicitly",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.rules_tick.multitenant", _rules_tick_multitenant_build,
+        _TICK,
+        notes="graft-surge packed cross-tenant tick: SURGE_TENANTS "
+              "regions on one resident state, every tenant's incidents "
+              "scored in ONE pass of the stock donated _tick; byte/FLOP "
+              "cost must stay exactly linear in the packed shapes and "
+              "zero-collective (tenant packing adds no comms)",
         cost=COST_DEFAULT),
     Entrypoint(
         "streaming.rules_tick.sharded", _sharded_rules_tick_build, _TICK,

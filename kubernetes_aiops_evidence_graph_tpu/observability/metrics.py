@@ -229,6 +229,33 @@ SERVE_FETCHED_BYTES = REGISTRY.counter(
     "aiops_serve_fetched_bytes_total",
     "Bytes actually moved device->host by serving fetches, by path label")
 
+# graft-surge instrumentation (rca/surge.py + the async workflow drive):
+# cross-tenant verdict batching on one resident state. The histogram is
+# the batching story in one surface — incidents scored per device pass,
+# labeled by how many tenants were packed onto the state; the gauge makes
+# per-tenant backpressure visible (staged-but-unticked delta entries).
+SERVE_BATCH_INCIDENTS = REGISTRY.histogram(
+    "aiops_serve_batch_incidents",
+    "Live incidents scored by one device pass of the resident serving "
+    "state, by tenants label (cross-tenant packing: N tenants' concurrent "
+    "incidents ride ONE jitted pass instead of one pass per incident)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+             1024.0))
+SERVE_TENANT_QUEUE_DEPTH = REGISTRY.gauge(
+    "aiops_serve_tenant_queue_depth",
+    "Pending (staged, not yet ticked) delta entries per tenant region of "
+    "the multi-tenant resident scorer, by tenant label")
+SERVE_TENANT_QUARANTINES = REGISTRY.counter(
+    "aiops_serve_tenant_quarantines_total",
+    "Tenant regions quarantined off the shared tick (poisoned deltas or "
+    "journal truncation), by tenant label — the other tenants' ticks "
+    "continue while the quarantined region re-mirrors from its store")
+SERVE_TENANT_REBUILDS = REGISTRY.counter(
+    "aiops_serve_tenant_rebuilds_total",
+    "Region-scoped tenant re-mirrors (store-derived heal staged as "
+    "in-place deltas) — the per-tenant rebuild that never stalls the "
+    "other tenants' ticks, by tenant label")
+
 # graft-shield instrumentation (rca/shield.py + rca/journal.py): the
 # crash-consistent recovery layer over the donated serving state. Every
 # degradation-tier transition and recovery action is counted — a recovery

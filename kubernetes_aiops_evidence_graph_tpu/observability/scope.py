@@ -119,7 +119,7 @@ class TickSpan:
 
     __slots__ = ("tick_id", "t0", "marks", "queue_wait_s", "coalesced",
                  "pending", "shard_rows", "tier", "flags", "depth",
-                 "backend", "fetched")
+                 "backend", "fetched", "batch_incidents", "tenants")
 
     def __init__(self, tick_id: int, backend: str, depth: int,
                  tier: str, queue_wait_s: float) -> None:
@@ -135,6 +135,11 @@ class TickSpan:
         self.shard_rows: tuple[int, ...] = ()
         self.flags: tuple[str, ...] = ()
         self.fetched = False
+        # graft-surge: incidents scored by this tick's device pass and
+        # how many tenants were packed onto the resident state — batched
+        # passes must be visible in forensics, not just in the histogram
+        self.batch_incidents = 0
+        self.tenants = 1
 
     def mark(self, stage: str) -> None:
         self.marks.append((stage, time.monotonic()))
@@ -168,6 +173,8 @@ class TickSpan:
             "pending": self.pending,
             "shard_rows": list(self.shard_rows),
             "flags": list(self.flags),
+            "batch_incidents": self.batch_incidents,
+            "tenants": self.tenants,
             "t_epoch_s": round(_epoch_of(self.t0), 6),
         }
 

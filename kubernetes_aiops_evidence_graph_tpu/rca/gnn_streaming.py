@@ -677,30 +677,22 @@ class GnnStreamingScorer(StreamingScorer):
         obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
                                  tick, args)
 
-    def rescore(self) -> dict:
-        """GnnRcaBackend.score_snapshot-shaped raw dict for live incidents.
-        Same caller-boundary contract as the base rescore: one fresh tick
-        reflecting every pending delta, older in-flight results dropped
-        unfetched, exactly one device_get, dispatch/fetch timings split."""
+    def _fetch_verdicts(self, handles, span, stats: dict,
+                        queue_wait_s: float, dispatch_s: float) -> dict:
+        """GnnRcaBackend.score_snapshot-shaped raw dict for live
+        incidents. The base rescore()/rescore_newest() drive this —
+        ``handles`` is this scorer's ``_tick_handles`` surface
+        ``(logits, probs)`` and only the probs pay the readback. Same
+        caller-boundary contract as the rules fetch: exactly one
+        device_get, dispatch/fetch timings split."""
         import time
         from ..observability import metrics as obs_metrics
-        stats = {"feature_updates": len(self._pending_feat),
-                 "structural_refresh": bool(self._dirty_rows),
-                 "rebuilds": self.rebuilds,
-                 "coalesced_ticks": self.coalesced_ticks,
-                 "deferred_fetches": self.deferred_fetches}
-        queue_wait_s = self._drain_queue_wait()
-        t1 = time.perf_counter()
-        self.dispatch()
-        span, self._last_tick_span = self._last_tick_span, None
-        self._supersede_inflight()
-        dispatch_s = time.perf_counter() - t1
         t2 = time.perf_counter()
         self._fault_point("fetch")
         if span is not None:
-            jax.block_until_ready(self._last_gnn[1])
+            jax.block_until_ready(handles[1])
             span.mark("execute")
-        probs = np.asarray(jax.device_get(self._last_gnn[1]))
+        probs = np.asarray(jax.device_get(handles[1]))
         fetch_s = time.perf_counter() - t2
         if span is not None:
             span.mark("fetch")

@@ -205,22 +205,36 @@ class ShieldedScorer:
 
     # -- protected serving API --------------------------------------------
 
-    def serve(self) -> dict:
+    def serve(self, newest: bool = False) -> dict:
         """Journal + sync + rescore under the shield lock. Callers are
         serialized here (the shield must observe every failure), so each
         caller's prior store writes are drained by its own staging pass —
         the same visibility guarantee scorer.serve()'s generation protocol
-        gives concurrent callers."""
-        return self.rescore()
+        gives concurrent callers. ``newest=True`` (the async workflow
+        verdict path, graft-surge) prefers the scorer's deferred
+        newest-tick fetch — bit-identical, and the finite guard runs on
+        the fetched result either way."""
+        return self.rescore(newest=newest)
 
-    def rescore(self) -> dict:
+    def rescore(self, newest: bool = False) -> dict:
         with self._lock:
+            if newest:
+                return self._run_with_recovery(
+                    lambda: self._tick_rescore(newest=True))
             return self._run_with_recovery(self._tick_rescore)
 
     def tick(self) -> dict:
         """Protected pipelined submission (scorer.tick_async)."""
         with self._lock:
             return self._run_with_recovery(self._tick_async)
+
+    def absorb(self) -> dict:
+        """Protected webhook-burst ingestion (graft-surge): WAL-journal +
+        apply the delta batch, then a pipelined tick submission. MUST
+        shadow the scorer's absorb() — a ``__getattr__`` fallthrough
+        would drain the store journal without write-ahead logging it,
+        silently breaking crash recovery."""
+        return self.tick()
 
     def sync(self) -> dict:
         """Journal + apply only (no dispatch) — for drivers that tick
@@ -230,9 +244,10 @@ class ShieldedScorer:
 
     # -- the guarded tick --------------------------------------------------
 
-    def _tick_rescore(self) -> dict:
+    def _tick_rescore(self, newest: bool = False) -> dict:
         self._stage_and_apply()
-        out = self.scorer.rescore()
+        out = (self.scorer.rescore_newest() if newest
+               else self.scorer.rescore())
         self._finite_guard(out)
         self._ticks_since_snapshot += 1
         if self._ticks_since_snapshot >= self.snapshot_every:

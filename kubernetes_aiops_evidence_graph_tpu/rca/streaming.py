@@ -210,6 +210,11 @@ class StreamingScorer:
         self.rebuilds = 0
         self.syncs = 0
         self.fetches = 0
+        # device scoring passes actually enqueued (dispatch() calls) —
+        # the denominator of the graft-surge batching story: N concurrent
+        # incidents served per pass means fewer dispatches, and the A/B
+        # bench and the perf_contract tests count exactly this
+        self.dispatches = 0
         # opt-in (the worker sets it): every shape change re-warms the
         # next bucket shapes on a background thread. _warm_lock guards the
         # active/pending/stop flags (see _rearm_warm_growth).
@@ -273,13 +278,10 @@ class StreamingScorer:
 
     # -- (re)initialisation ------------------------------------------------
 
-    def _init_from_store(self) -> None:
-        """Tensorize the store and derive the host-authoritative incremental
-        state. Called at construction and on bucket-overflow rebuilds.
-        Buckets are picked with 1/3 growth slack so structural churn lands
-        in free padded rows instead of forcing mid-stream rebuilds."""
-        # a rebuild supersedes every in-flight tick result (and their
-        # buffers carry the OLD shapes): drop them unfetched
+    def _drop_stale_inflight(self) -> None:
+        """A rebuild supersedes every in-flight tick result (and their
+        buffers carry the OLD shapes): drop them unfetched. Shared with
+        the multi-tenant pack rebuild (rca/surge.py)."""
         stale = getattr(self, "_inflight", None)
         if stale:
             self.deferred_fetches += len(stale)
@@ -290,6 +292,13 @@ class StreamingScorer:
             for sp in stale_meta:
                 self.scope.finalize(sp)
             stale_meta.clear()
+
+    def _init_from_store(self) -> None:
+        """Tensorize the store and derive the host-authoritative incremental
+        state. Called at construction and on bucket-overflow rebuilds.
+        Buckets are picked with 1/3 growth slack so structural churn lands
+        in free padded rows instead of forcing mid-stream rebuilds."""
+        self._drop_stale_inflight()
         # capture the journal cursor BEFORE tensorizing: mutations landing
         # in between are both in the snapshot and replayed by the next
         # sync(), and every mirror op is an idempotent MERGE, so replays
@@ -368,6 +377,53 @@ class StreamingScorer:
         # same row within one tick must collapse to one entry (ADVICE r2).
         self._pending_feat: dict[int, np.ndarray] = {}
         self._dirty_rows: set[int] = set()
+
+    # -- slot-space seams (graft-surge) ------------------------------------
+    #
+    # The multi-tenant pack (rca/surge.py) carves the node/incident slot
+    # spaces into per-tenant regions: allocation must stay inside the id's
+    # region and store lookups must resolve through the id's tenant store.
+    # The base scorer serves ONE store, so these default to the single
+    # free lists / the single store — zero behavior change.
+
+    def _node_row_available(self, node_id: str) -> bool:
+        return bool(self._free_node_rows)
+
+    def _take_node_row(self, node_id: str) -> int:
+        return self._free_node_rows.pop()
+
+    def _put_node_row(self, row: int) -> None:
+        self._free_node_rows.append(row)
+
+    def _inc_row_available(self, node_id: str) -> bool:
+        return bool(self._free_inc_rows)
+
+    def _take_inc_row(self, node_id: str) -> int:
+        return self._free_inc_rows.pop()
+
+    def _put_inc_row(self, row: int) -> None:
+        self._free_inc_rows.append(row)
+
+    def _store_node(self, node_id: str):
+        """The live store node behind a (possibly tenant-namespaced) id."""
+        return self.store._nodes.get(node_id)
+
+    def _canon_incident_id(self, incident_node_id: str) -> str:
+        """Canonical incident node id: bare uuids gain the ``incident:``
+        prefix. The multi-tenant pack overrides this — its journal-driven
+        ids arrive already canonical and namespaced."""
+        return incident_node_id if incident_node_id.startswith("incident:") \
+            else f"incident:{incident_node_id}"
+
+    def _tenant_count(self) -> int:
+        """Tenants packed onto this resident state (1 for the base
+        scorer); labels the per-pass incident-batch histogram."""
+        return 1
+
+    def serving_node_id(self, node_id: str, tenant: str = "default") -> str:
+        """Translate a store-local node id into this scorer's slot-space
+        id (the multi-tenant pack namespaces per tenant)."""
+        return node_id
 
     def _sharded(self, pi: int) -> bool:
         """True when `pi` incident rows can shard over the mesh's dp axis."""
@@ -632,11 +688,11 @@ class StreamingScorer:
         remove, so there is no row to report and none is needed)."""
         if node_id in self._id_to_idx:
             return self._id_to_idx[node_id]
-        if not self._free_node_rows:
+        if not self._node_row_available(node_id):
             self._rebuild()
             return self._id_to_idx.get(node_id, -1)
-        row = self._free_node_rows.pop()
-        node = self.store._nodes.get(node_id)
+        row = self._take_node_row(node_id)
+        node = self._store_node(node_id)
         self._node_ids[row] = node_id
         self._id_to_idx[node_id] = row
         self.snapshot.node_mask[row] = 1.0
@@ -676,7 +732,7 @@ class StreamingScorer:
             for r in affected:
                 self._recompact_pairs(r)
         self._node_ids[row] = None
-        self._free_node_rows.append(row)
+        self._put_node_row(row)
         self.snapshot.node_mask[row] = 0.0
         self.snapshot.features[row] = 0.0
         self._pending_feat[row] = np.zeros(
@@ -694,7 +750,7 @@ class StreamingScorer:
         if incident_node_id in self._inc_row_of:
             r = self._inc_row_of[incident_node_id]
         else:
-            if not self._free_inc_rows:
+            if not self._inc_row_available(incident_node_id):
                 self._rebuild()
                 return self._inc_row_of.get(incident_node_id, -1)
             rb = self.rebuilds
@@ -705,7 +761,7 @@ class StreamingScorer:
                 # row here would leak the first one (or, if the incident was
                 # closed later in the same sync batch, it has no row at all)
                 return self._inc_row_of.get(incident_node_id, -1)
-            r = self._free_inc_rows.pop()
+            r = self._take_inc_row(incident_node_id)
             self._inc_row_of[incident_node_id] = r
             self._row_inc[r] = incident_node_id
             self.snapshot.incident_nodes[r] = nrow
@@ -716,8 +772,7 @@ class StreamingScorer:
 
     def close_incident(self, incident_node_id: str) -> bool:
         """Incident closure: clear the row's evidence and free it."""
-        nid = incident_node_id if incident_node_id.startswith("incident:") \
-            else f"incident:{incident_node_id}"
+        nid = self._canon_incident_id(incident_node_id)
         r = self._inc_row_of.pop(nid, None)
         if r is None:
             return False
@@ -729,7 +784,7 @@ class StreamingScorer:
         self._row_pairs[r] = []
         self._pair_map[r] = {}
         self._row_inc[r] = None
-        self._free_inc_rows.append(r)
+        self._put_inc_row(r)
         self.snapshot.incident_mask[r] = 0.0
         self._dirty_rows.add(r)
         self.remove_entity(nid)
@@ -920,7 +975,7 @@ class StreamingScorer:
         n = 0
         for nid in node_ids:
             idx = self._id_to_idx.get(nid)
-            node = self.store._nodes.get(nid)
+            node = self._store_node(nid)
             if idx is None or node is None:
                 continue
             row = extract_node_features(node, now_s=self.now_s)
@@ -1137,53 +1192,78 @@ class StreamingScorer:
         """Pre-compile the fused tick at every shape a rebuild could land
         on (see _growth_shape_combos) so a bucket-overflow rebuild
         mid-serve pays tensorize + upload but NOT an XLA compile (~2 s
-        hiccup measured at the serving bench when uncached). The
-        post-rebuild dispatch always uses the smallest delta buckets —
-        sync() stops replaying once a rebuild fires — so only those are
-        warmed. Stand-in zero states at the target shapes are compiled and
+        hiccup measured at the serving bench when uncached). The delta
+        buckets warmed per shape come from ``_growth_warm_buckets`` —
+        the smallest ones for the base scorer (sync() stops replaying
+        once a rebuild fires, so the post-rebuild dispatch carries ~no
+        deltas); the multi-tenant pack widens the ladder (see the seam).
+        Stand-in zero states at the target shapes are compiled and
         discarded; the jit cache keys on shapes, so the later real rebuild
         hits the cache. Runs on background threads (worker cold start +
         auto re-arm on every shape change when ``auto_warm_growth`` is
         set); stop_warm() bounds shutdown to the one in-flight compile."""
-        pk, rk = _DELTA_BUCKETS[0], _ROW_BUCKETS[0]
+        pks, rks = self._growth_warm_buckets()
         for cpn, cpi, width, pw, dim in self._growth_shape_combos():
-            if self._warm_stop:
-                return
-            feats = jnp.zeros((cpn, dim), jnp.float32)
-            tables = (jnp.zeros((cpi, width), jnp.int32),
-                      jnp.zeros((cpi,), jnp.int32),
-                      jnp.full((cpi, width), pw, jnp.int32))
-            chain = jnp.zeros((cpi,), jnp.float32)
-            if self._sharded(cpi):
-                # match the placement the real rebuilt state will have:
-                # compiled executables key on input shardings
-                rep, row1, row2 = self._shardings(cpn, cpi)
-                feats = jax.device_put(feats, rep)
-                tables = (jax.device_put(tables[0], row2),
-                          jax.device_put(tables[1], row1),
-                          jax.device_put(tables[2], row2))
-                chain = jax.device_put(chain, row1)
+            sharded = self._sharded(cpi)
+            shardings = self._shardings(cpn, cpi) if sharded else None
             gshards = (self._graph_size()
                        if self._graph_sharded(cpn, cpi) else 1)
-            if gshards > 1:
-                ints = _pack_ints_sharded(
-                    np.full((gshards, pk), cpn // gshards, np.int32),
-                    np.full(rk, cpi, np.int32),
-                    np.zeros(rk, np.int32),
-                    np.zeros((rk, width), np.int32),
-                    np.full((rk, width), pw, np.int32))
-                f_rows = np.zeros((gshards, pk, dim), np.float32)
-            else:
-                ints = _pack_ints(
-                    np.full(pk, cpn, np.int32),   # all-dropped deltas
-                    np.full(rk, cpi, np.int32),
-                    np.zeros(rk, np.int32),
-                    np.zeros((rk, width), np.int32),
-                    np.full((rk, width), pw, np.int32))
-                f_rows = np.zeros((pk, dim), np.float32)
-            self._tick_fn(cpn, cpi, width, pw, pk=pk, rk=rk)(
-                feats, jnp.asarray(ints),
-                jnp.asarray(f_rows), *tables, chain)
+
+            def standins():
+                # FRESH per tick call: the tick donates its state inputs,
+                # so a reused stand-in would be a dead buffer — placed
+                # like the real rebuilt state will be (executables key on
+                # input shardings)
+                feats = jnp.zeros((cpn, dim), jnp.float32)
+                tables = (jnp.zeros((cpi, width), jnp.int32),
+                          jnp.zeros((cpi,), jnp.int32),
+                          jnp.full((cpi, width), pw, jnp.int32))
+                chain = jnp.zeros((cpi,), jnp.float32)
+                if sharded:
+                    rep, row1, row2 = shardings
+                    feats = jax.device_put(feats, rep)
+                    tables = (jax.device_put(tables[0], row2),
+                              jax.device_put(tables[1], row1),
+                              jax.device_put(tables[2], row2))
+                    chain = jax.device_put(chain, row1)
+                return feats, tables, chain
+
+            for pk in pks:
+                for rk in rks:
+                    if self._warm_stop:
+                        return
+                    feats, tables, chain = standins()
+                    if gshards > 1:
+                        ints = _pack_ints_sharded(
+                            np.full((gshards, pk), cpn // gshards,
+                                    np.int32),
+                            np.full(rk, cpi, np.int32),
+                            np.zeros(rk, np.int32),
+                            np.zeros((rk, width), np.int32),
+                            np.full((rk, width), pw, np.int32))
+                        f_rows = np.zeros((gshards, pk, dim), np.float32)
+                    else:
+                        ints = _pack_ints(
+                            np.full(pk, cpn, np.int32),  # all-dropped
+                            np.full(rk, cpi, np.int32),
+                            np.zeros(rk, np.int32),
+                            np.zeros((rk, width), np.int32),
+                            np.full((rk, width), pw, np.int32))
+                        f_rows = np.zeros((pk, dim), np.float32)
+                    self._tick_fn(cpn, cpi, width, pw, pk=pk, rk=rk)(
+                        feats, jnp.asarray(ints),
+                        jnp.asarray(f_rows), *tables, chain)
+
+    def _growth_warm_buckets(self) -> "tuple[tuple[int, ...], tuple[int, ...]]":
+        """(pk ladder, rk ladder) warm_growth compiles per target shape.
+        The base scorer's post-rebuild dispatch always lands on the
+        smallest delta buckets (sync() stops replaying once a rebuild
+        fires, so the next tick carries ~no deltas). The multi-tenant
+        pack overrides this: a mid-batch incremental repack leaves the
+        KEPT tenants' un-drained journal records for the next sync, so
+        its first post-repack ticks legitimately carry multi-tenant
+        delta batches on larger buckets (rca/surge.py)."""
+        return (_DELTA_BUCKETS[:1], _ROW_BUCKETS[:1])
 
     def warm_serving(self) -> None:
         """Cold-start warm for the serving path, run off-thread by the
@@ -1265,16 +1345,7 @@ class StreamingScorer:
         # the dispatch fault fires after the pending deltas were drained,
         # so a bare retry cannot restage them: journal replay must
         f_rows = self._fault_value("delta_values", f_rows)
-        if self.finite_delta_guard and not np.isfinite(f_rows).all():
-            # O(delta) host check, not O(N): quarantine-grade poison is
-            # caught BEFORE it scatters into the donated state
-            if span is not None:
-                span.flag("nonfinite_delta")
-                self.scope.finalize(span)
-                self._last_tick_span = None
-            raise NonFiniteDelta(
-                f"{int((~np.isfinite(f_rows)).any(axis=-1).sum())} "
-                "non-finite staged feature rows")
+        f_idx, f_rows = self._screen_delta(f_idx, f_rows, span)
         self._fault_point("dispatch")
         if sharded:
             ints = _pack_ints_sharded(f_idx, r_idx, r_cnt, r_ev, r_pair)
@@ -1309,9 +1380,38 @@ class StreamingScorer:
         # already dead and the outputs may be poisoned — the shield's
         # recovery tiers are the only way back to the pre-fault state
         self._fault_point("execute")
+        self.dispatches += 1
+        # graft-surge: every device pass scores EVERY live incident on
+        # the resident state — the histogram makes cross-tenant batching
+        # visible (N incidents / pass, labeled by how many tenants packed)
+        batch = len(self._inc_row_of)
+        obs_metrics.SERVE_BATCH_INCIDENTS.observe(
+            float(batch), tenants=str(self._tenant_count()))
         if span is not None:
+            span.batch_incidents = batch
+            span.tenants = self._tenant_count()
             span.mark("dispatch")
         return out[4:]
+
+    def _screen_delta(self, f_idx: np.ndarray, f_rows: np.ndarray,
+                      span) -> tuple[np.ndarray, np.ndarray]:
+        """Finite guard over the staged feature rows, applied after the
+        pending deltas were drained and before they scatter into the
+        donated state. The base scorer raises :class:`NonFiniteDelta`
+        (the shield quarantines the batch and replays); the multi-tenant
+        pack overrides this to quarantine only the POISONED tenants'
+        rows so the other tenants' tick proceeds (rca/surge.py)."""
+        if self.finite_delta_guard and not np.isfinite(f_rows).all():
+            # O(delta) host check, not O(N): quarantine-grade poison is
+            # caught BEFORE it scatters into the donated state
+            if span is not None:
+                span.flag("nonfinite_delta")
+                self.scope.finalize(span)
+                self._last_tick_span = None
+            raise NonFiniteDelta(
+                f"{int((~np.isfinite(f_rows)).any(axis=-1).sum())} "
+                "non-finite staged feature rows")
+        return f_idx, f_rows
 
     def _scope_entrypoint(self, sharded: bool) -> str:
         return ("streaming.rules_tick.sharded" if sharded
@@ -1472,39 +1572,67 @@ class StreamingScorer:
         oldest tick (counted in ``stall_seconds``). Returns a small stats
         dict; results are fetched later via rescore()/serve()."""
         with self.serve_lock:
-            self._retire_ready()
-            if len(self._inflight) >= self.pipeline_depth:
-                pending = self._pending_delta_count()
-                if pending < self._coalesce_bound:
-                    self.coalesced_ticks += 1
-                    self._scope_coalesced_since += 1
-                    self.scope.note_coalesced(pending)
-                    obs_metrics.SERVE_COALESCED_TICKS.inc()
-                    obs_metrics.SERVE_COALESCED_TICK_SIZE.set(float(pending))
-                    return {"dispatched": False, "coalesced": True,
-                            "inflight": len(self._inflight),
-                            "pending": pending}
-                t0 = time.perf_counter()
-                oldest = self._inflight.popleft()
-                jax.block_until_ready(oldest[-1])
-                stall = time.perf_counter() - t0
-                self.stall_seconds += stall
-                self.deferred_fetches += 1
-                # the stall is queue pressure charged to the tick about
-                # to dispatch; the drained tick's completion was just
-                # host-observed, so stamp its execute boundary
-                self.scope.note_queue_wait(stall)
-                self._retire_meta(mark_execute=True)
-                obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(stall)
-                obs_metrics.SERVE_DEFERRED_FETCHES.inc()
-            out = self.dispatch()
-            self._inflight.append(self._tick_handles(out))
-            self._inflight_meta.append(self._last_tick_span)
-            self._last_tick_span = None
-            obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
-                float(len(self._inflight)))
-            return {"dispatched": True, "coalesced": False,
-                    "inflight": len(self._inflight), "pending": 0}
+            return self._tick_async_locked()
+
+    def absorb(self) -> dict:
+        """Webhook-burst ingestion (graft-surge): drain the store
+        journal(s) and submit ONE pipelined tick without fetching — the
+        workflow worker calls this right after graph ingest, so the
+        incident's deltas ride the bounded tick_async queue (coalescing
+        on the delta ladder under bursts) and the device executes while
+        the workflow's host steps continue. The verdict boundary then
+        pays only a deferred newest-tick fetch (``serve(newest=True)``)
+        instead of a synchronous per-incident dispatch+fetch round-trip.
+        One lock acquisition covers sync + submit, so a concurrent
+        absorb/serve cannot interleave between the journal drain and the
+        tick that carries its deltas. NON-blocking by design: when a
+        caller-boundary tick or fetch holds the serving state, absorb
+        yields immediately (``busy``) instead of serializing webhook
+        ingest behind device readbacks — the deltas stay in the journal
+        and the contending boundary's own sync drains them."""
+        if not self.serve_lock.acquire(blocking=False):
+            return {"dispatched": False, "coalesced": False, "busy": True}
+        try:
+            self.sync()
+            return self._tick_async_locked()
+        finally:
+            self.serve_lock.release()
+
+    def _tick_async_locked(self) -> dict:
+        """tick_async body; the caller holds ``serve_lock``."""
+        self._retire_ready()
+        if len(self._inflight) >= self.pipeline_depth:
+            pending = self._pending_delta_count()
+            if pending < self._coalesce_bound:
+                self.coalesced_ticks += 1
+                self._scope_coalesced_since += 1
+                self.scope.note_coalesced(pending)
+                obs_metrics.SERVE_COALESCED_TICKS.inc()
+                obs_metrics.SERVE_COALESCED_TICK_SIZE.set(float(pending))
+                return {"dispatched": False, "coalesced": True,
+                        "inflight": len(self._inflight),
+                        "pending": pending}
+            t0 = time.perf_counter()
+            oldest = self._inflight.popleft()
+            jax.block_until_ready(oldest[-1])
+            stall = time.perf_counter() - t0
+            self.stall_seconds += stall
+            self.deferred_fetches += 1
+            # the stall is queue pressure charged to the tick about
+            # to dispatch; the drained tick's completion was just
+            # host-observed, so stamp its execute boundary
+            self.scope.note_queue_wait(stall)
+            self._retire_meta(mark_execute=True)
+            obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(stall)
+            obs_metrics.SERVE_DEFERRED_FETCHES.inc()
+        out = self.dispatch()
+        self._inflight.append(self._tick_handles(out))
+        self._inflight_meta.append(self._last_tick_span)
+        self._last_tick_span = None
+        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
+            float(len(self._inflight)))
+        return {"dispatched": True, "coalesced": False,
+                "inflight": len(self._inflight), "pending": 0}
 
     def _supersede_inflight(self) -> None:
         """A fresh caller-boundary tick makes every queued result stale:
@@ -1519,8 +1647,14 @@ class StreamingScorer:
             self._retire_meta()
         obs_metrics.SERVE_PIPELINE_INFLIGHT.set(0.0)
 
-    def serve(self) -> dict:
+    def serve(self, newest: bool = False) -> dict:
         """Coalesced sync + rescore for concurrent serving callers.
+
+        With ``newest=True`` (the async workflow verdict path,
+        graft-surge) the ticker prefers the deferred newest-tick fetch:
+        when absorb() already drained the journal and submitted the tick,
+        the generation costs one readback and ZERO fresh dispatches —
+        see :meth:`rescore_newest` for the exact fallback conditions.
 
         The reference pays one Temporal activity chain per incident
         (activities.py:26-164); the fused tick already scores EVERY live
@@ -1548,7 +1682,7 @@ class StreamingScorer:
         try:
             with self.serve_lock:
                 self.sync()
-                result = self.rescore()
+                result = self.rescore_newest() if newest else self.rescore()
         except BaseException:
             with self._serve_cv:
                 # roll back so a waiter can claim this generation; waiters
@@ -1605,22 +1739,59 @@ class StreamingScorer:
                  "structural_refresh": bool(self._dirty_rows),
                  "rebuilds": self.rebuilds,
                  "coalesced_ticks": self.coalesced_ticks,
-                 "deferred_fetches": self.deferred_fetches}
+                 "deferred_fetches": self.deferred_fetches,
+                 "newest_fetch": False}
         queue_wait_s = self._drain_queue_wait()
         t1 = time.perf_counter()
         out = self.dispatch()
         span, self._last_tick_span = self._last_tick_span, None
+        handles = self._tick_handles(out)
         self._supersede_inflight()
         dispatch_s = time.perf_counter() - t1
+        return self._fetch_verdicts(handles, span, stats,
+                                    queue_wait_s, dispatch_s)
+
+    def rescore_newest(self) -> dict:
+        """Deferred newest-tick verdict fetch (graft-surge): when the
+        journal is drained and NO deltas are pending, the newest
+        in-flight tick already reflects every store write — fetch ITS
+        result handles (one device_get, older queued results dropped
+        unfetched) without dispatching a fresh tick at all. This is the
+        caller boundary the async workflow path hits in steady state:
+        absorb() submitted the tick at webhook-ingest time, the device
+        executed it while the workflow's host steps ran, and the verdict
+        costs a readback only. Falls back to a full rescore() whenever
+        deltas are pending or nothing is in flight (correctness first:
+        a caller's store writes must always be reflected). Caller holds
+        ``serve_lock`` (serve() does)."""
+        if self._pending_delta_count() or not self._inflight:
+            return self.rescore()
+        stats = {"feature_updates": 0,
+                 "structural_refresh": False,
+                 "rebuilds": self.rebuilds,
+                 "coalesced_ticks": self.coalesced_ticks,
+                 "deferred_fetches": self.deferred_fetches,
+                 "newest_fetch": True}
+        handles = self._inflight.pop()          # newest submission
+        span = self._inflight_meta.pop() if self._inflight_meta else None
+        self._supersede_inflight()              # rest superseded, unfetched
+        return self._fetch_verdicts(handles, span, stats, 0.0, 0.0)
+
+    def _fetch_verdicts(self, handles, span, stats: dict,
+                        queue_wait_s: float, dispatch_s: float) -> dict:
+        """One blocking device_get over a tick's result handles → the
+        caller-facing raw verdict dict. Shared tail of rescore() (fresh
+        dispatch) and rescore_newest() (deferred newest-tick fetch);
+        GnnStreamingScorer overrides it for its probs-only readback."""
         t2 = time.perf_counter()
         self._fault_point("fetch")
         if span is not None:
             # the block is the fetch's own device wait made explicit (a
             # host boundary the device_get below would cross anyway):
             # splits the span's execute window from the readback
-            jax.block_until_ready(out)
+            jax.block_until_ready(handles)
             span.mark("execute")
-        fetched = jax.device_get(out)
+        fetched = jax.device_get(handles)
         fetch_s = time.perf_counter() - t2
         if span is not None:
             span.mark("fetch")

@@ -510,6 +510,87 @@ class TpuRcaBackend:
             res[k] = a[:n]
         return res
 
+    # static incident-bucket ladder for the packed cross-tenant pass
+    # (graft-surge): the packed row count pads up this ladder so the
+    # number of compiled variants stays discrete as tenant sets vary
+    _PACK_BUCKETS = (8, 32, 128, 512, 2048)
+
+    def score_snapshots(self, snapshots: "list[GraphSnapshot]",
+                        fields: str = "top") -> list[dict]:
+        """Cross-tenant verdict batching on the SNAPSHOT path: score k
+        tenants' snapshots in ONE ``_score_device`` pass (graft-surge).
+
+        The per-tenant batches pack along the incident axis (padded rows
+        concatenated, then padded up the static ``_PACK_BUCKETS`` ladder)
+        with each tenant's evidence slot indices offset by its feature
+        base — per-tenant node-id namespacing in the slot space. Widths
+        and the pair bucket take the max over tenants; the extra padded
+        slots fold exact zeros, so each verdict row is bit-identical to
+        that tenant's own ``score_snapshot`` (pinned by
+        tests/test_surge.py at every ladder rung). One dispatch + one
+        readback total; per-tenant row slices unpack at the fetch."""
+        if not snapshots:
+            return []
+        batches = [prepare_batch(s) for s in snapshots]
+        width = max(b.ev_idx.shape[1] for b in batches)
+        pair_width = max(b.pair_width for b in batches)
+        total = sum(b.padded_incidents for b in batches)
+        pi = bucket_for(total, self._PACK_BUCKETS)
+        features = np.concatenate([b.features for b in batches], axis=0)
+        ev_idx = np.zeros((pi, width), np.int32)
+        ev_cnt = np.zeros(pi, np.int32)
+        ev_pair = np.full((pi, width), pair_width, np.int32)
+        slices: list[tuple[int, int]] = []
+        row = base = 0
+        for b in batches:
+            k, w = b.padded_incidents, b.ev_idx.shape[1]
+            # slot indices shift into the tenant's feature region; the
+            # dead slots beyond ev_cnt gather garbage rows that the
+            # count-derived mask multiplies to exact zero, same as the
+            # single-tenant pass
+            ev_idx[row:row + k, :w] = b.ev_idx + base
+            ev_cnt[row:row + k] = b.ev_cnt
+            # re-stamp each tenant's "no node" sentinel to the pack's
+            ev_pair[row:row + k, :w] = np.where(
+                b.ev_pair_slot >= b.pair_width, pair_width, b.ev_pair_slot)
+            slices.append((row, k))
+            row += k
+            base += b.features.shape[0]
+        t1 = time.perf_counter()
+        out = _score_device(
+            jnp.asarray(features), jnp.asarray(ev_idx),
+            jnp.asarray(ev_cnt), jnp.asarray(ev_pair),
+            jnp.zeros((pi,), jnp.float32),
+            padded_incidents=pi, pair_width=pair_width)
+        dispatch_s = time.perf_counter() - t1
+        all_fields = self._FETCH_FIELDS["full"]
+        keys = self._FETCH_FIELDS[fields]
+        t2 = time.perf_counter()
+        fetched = jax.device_get(
+            tuple(out[all_fields.index(k)] for k in keys))  # one readback
+        fetch_s = time.perf_counter() - t2
+        from ..observability import metrics as obs_metrics
+        obs_metrics.SERVE_FETCHED_BYTES.inc(
+            float(sum(a.nbytes for a in fetched)), path="score_snapshots")
+        obs_metrics.SERVE_BATCH_INCIDENTS.observe(
+            float(sum(s.num_incidents for s in snapshots)),
+            tenants=str(len(snapshots)))
+        res: list[dict] = []
+        for snap, (r0, _k) in zip(snapshots, slices):
+            n = snap.num_incidents
+            one = {
+                "incident_ids": snap.incident_ids,
+                "dispatch_seconds": dispatch_s,
+                "fetch_seconds": fetch_s,
+                "device_seconds": dispatch_s + fetch_s,
+                "fetched_fields": fields,
+                "device_passes": 1,
+            }
+            for k, a in zip(keys, fetched):
+                one[k] = a[r0:r0 + n]
+            res.append(one)
+        return res
+
     def results(self, snapshot: GraphSnapshot | None = None,
                 raw: dict | None = None) -> list[RCAResult]:
         """Materialize RCAResult models (host-side, for the workflow path).

@@ -37,6 +37,8 @@ class IncidentWorker:
         settings: Settings | None = None,
         concurrency: int = 4,
         dedup: Any = None,
+        surge: Any = None,
+        tenant: str = "default",
     ) -> None:
         self.cluster = cluster
         self.db = db
@@ -54,6 +56,27 @@ class IncidentWorker:
         self.scorer: Any = None
         self._scorer_lock = threading.Lock()
         self._warm_thread: threading.Thread | None = None
+        # graft-surge: attach this worker's store to a shared multi-tenant
+        # SurgeServer (rca/surge.py) — N per-tenant workers then serve off
+        # ONE resident pack and their concurrent incidents score in one
+        # device pass. Registration is cheap; the pack builds lazily at
+        # first serve. ``tenant`` labels this worker's region/SLO samples.
+        if surge is not None and self.settings.rca_backend != "tpu":
+            # the pack batches the rules scorer's verdict pass; other
+            # backends keep their per-tenant resident scorer
+            log.warning("surge_requires_tpu_backend",
+                        rca_backend=self.settings.rca_backend)
+            surge = None
+        self.surge = surge
+        self.tenant = tenant
+        if surge is not None:
+            surge.register(tenant, self.builder.store)
+        # once the scorer question is settled (a resident scorer exists,
+        # or the backend has none), steady-state incidents skip the
+        # executor hop entirely — `scorer_resolutions` counts the slow
+        # path so tests can pin the fast path actually engages
+        self._scorer_resolved = False
+        self.scorer_resolutions = 0
 
     def serving_scorer(self) -> Any:
         """Lazily build the shared resident scorer: StreamingScorer for
@@ -61,6 +84,26 @@ class IncidentWorker:
         learned backend serves under churn too — VERDICT r4 ask 2)."""
         if self.settings.rca_backend not in ("tpu", "gnn"):
             return None
+        if self.surge is not None and self.settings.rca_backend == "tpu":
+            # graft-surge: the shared multi-tenant pack IS this worker's
+            # resident scorer. scorer() (re)builds under the server's own
+            # lock when tenants registered since the last build; the
+            # shield wrap is a single-store layer and stays off the pack
+            # (each tenant's quarantine/heal ladder covers poison, and
+            # the pack rebuilds store-derived — logged, never silent).
+            if self.settings.shield_enabled:
+                log.warning("surge_shield_unsupported", tenant=self.tenant)
+            scorer = self.surge.scorer()
+            with self._scorer_lock:
+                if not getattr(scorer, "_surge_warm_started", False):
+                    scorer._surge_warm_started = True
+                    scorer.auto_warm_growth = True
+                    self._warm_thread = threading.Thread(
+                        target=scorer.warm_serving,
+                        name="kaeg-warm-serving", daemon=False)
+                    self._warm_thread.start()
+                self.scorer = scorer
+            return scorer
         with self._scorer_lock:
             if self.scorer is None:
                 if self.settings.rca_backend == "gnn":
@@ -135,13 +178,24 @@ class IncidentWorker:
             try:
                 # scorer construction tensorizes the whole store (O(N) +
                 # device upload) — run it on an executor thread so the
-                # one-time cold start never freezes the event loop
-                scorer = await asyncio.get_event_loop().run_in_executor(
-                    None, self.serving_scorer)
+                # one-time cold start never freezes the event loop. Once
+                # resolved (warm scorer, or a backend with none), the
+                # fast path reuses the cached reference: steady-state
+                # incidents pay zero thread round-trips here
+                # (graft-surge satellite). A stale surge pack (tenant
+                # registered after the build) re-enters the slow path.
+                if self._scorer_resolved and (
+                        self.surge is None or self.surge.fresh()):
+                    scorer = self.scorer
+                else:
+                    self.scorer_resolutions += 1
+                    scorer = await asyncio.get_event_loop().run_in_executor(
+                        None, self.serving_scorer)
+                    self._scorer_resolved = True
                 await run_incident_workflow(
                     incident, self.cluster, self.db, builder=self.builder,
                     settings=self.settings, engine=self.engine,
-                    dedup=self.dedup, scorer=scorer)
+                    dedup=self.dedup, scorer=scorer, tenant=self.tenant)
                 self.completed += 1
             except Exception as exc:  # graft-audit: allow[broad-except] per-incident isolation: one failed workflow must not kill the serve loop
                 self.failed += 1

@@ -342,19 +342,22 @@ def test_concurrent_serving_coalesces_device_fetches(monkeypatch):
 
     from kubernetes_aiops_evidence_graph_tpu.rca.streaming import StreamingScorer
 
-    # Deterministic overlap: the FIRST tick's rescore blocks until all 4
-    # incidents have entered serve(), so callers 2-4 are provably assigned
-    # to the one follow-up tick (same protocol the unit test pins).
+    # Deterministic overlap: the FIRST generation's verdict fetch blocks
+    # until all 4 incidents have entered serve(), so callers 2-4 are
+    # provably assigned to the one follow-up tick (same protocol the
+    # unit test pins). Gating the shared _fetch_verdicts seam covers
+    # both the fresh-dispatch rescore and the graft-surge deferred
+    # newest-tick fetch — whichever path generation 1 takes.
     serve_entries = threading.Semaphore(0)
     real_serve = StreamingScorer.serve
-    real_rescore = StreamingScorer.rescore
+    real_fetch = StreamingScorer._fetch_verdicts
     first = [True]
 
-    def counting_serve(self):
+    def counting_serve(self, newest=False):
         serve_entries.release()
-        return real_serve(self)
+        return real_serve(self, newest=newest)
 
-    def gated_rescore(self):
+    def gated_fetch(self, *args, **kwargs):
         if first[0]:
             first[0] = False
             deadline = _time.monotonic() + 30
@@ -363,10 +366,10 @@ def test_concurrent_serving_coalesces_device_fetches(monkeypatch):
                 if serve_entries.acquire(timeout=0.1):
                     acquired += 1
             _time.sleep(0.3)  # let late entrants reach the condition wait
-        return real_rescore(self)
+        return real_fetch(self, *args, **kwargs)
 
     monkeypatch.setattr(StreamingScorer, "serve", counting_serve)
-    monkeypatch.setattr(StreamingScorer, "rescore", gated_rescore)
+    monkeypatch.setattr(StreamingScorer, "_fetch_verdicts", gated_fetch)
 
     cluster = generate_cluster(num_pods=96, seed=0)
     inject(cluster, "crashloop_deploy", "default/svc-0",

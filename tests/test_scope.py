@@ -320,6 +320,17 @@ def test_bench_webhook_verdict_slo_record_hermetic():
     # the exported histogram surface agrees with the exact quantiles to
     # bucket resolution (its buckets bound the exact values from above)
     assert rec["histogram_p99_ms"] > 0
+    # graft-surge: the batched-vs-unbatched A/B rides the same record —
+    # device passes per arm counted from scorer.dispatches, and the
+    # batched arm must use strictly fewer (the tentpole's win is a
+    # number in the record, not a claim)
+    ab = rec["batched_ab"]
+    for arm in ("batched", "unbatched"):
+        for key in ("p50_ms", "p99_ms", "device_passes", "verdicts",
+                    "verdicts_per_sec", "wall_s"):
+            assert key in ab[arm], f"missing A/B field {arm}.{key}"
+    assert ab["device_passes_fewer"] is True
+    assert ab["batched"]["device_passes"] < ab["unbatched"]["device_passes"]
 
 
 def test_sharded_route_counts_reach_gauge_and_flight_record():
