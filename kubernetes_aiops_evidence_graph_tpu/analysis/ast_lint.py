@@ -59,7 +59,7 @@ from pathlib import Path
 
 from .findings import Finding, Report
 
-HOT_DIRS = {"rca", "ops", "parallel"}
+HOT_DIRS = {"rca", "ops", "parallel", "learn"}
 
 # functions that run under trace without their own jit decoration (called
 # from jitted entrypoints in the hot modules) — tracer-branch and
@@ -134,6 +134,12 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
         ("num_nodes", "iterations"), ()),
     ("parallel/sharded_gnn.py", "step"): ((), (0, 1)),
     ("parallel/sharded_rules.py", "sharded"): ((), ()),
+    # graft-evolve fine-tune step (learn/trainer.py): same donation
+    # discipline as the offline step — params/opt_state consumed and
+    # rebound every step; the anchor (the serving checkpoint) is READ
+    # every step and must NOT be donated
+    ("learn/trainer.py", "step"): (("rel_offsets", "slices_sorted"),
+                                   (0, 1)),
 }
 
 _WAIVER_RE = re.compile(

@@ -165,6 +165,36 @@ def _train_step_build():
     return fn, (params, opt_state, batch)
 
 
+def _finetune_step_build():
+    """graft-evolve: the online fine-tune step (learn/trainer.py) at the
+    canonical training shapes — the offline train step's loss through the
+    bucketed kernel PLUS the proximal anchor term pulling the candidate
+    toward the serving checkpoint. The anchor adds elementwise work only
+    (sum of squared diffs over ~params-sized leaves), so the jaxpr must
+    stay inside the same budget/sorted-scatter contract as
+    gnn.train_step.bucketed, and the ratchet pins that the anchor never
+    quietly grows into something matmul-shaped."""
+    try:
+        import optax
+    except ImportError as exc:                  # pragma: no cover
+        raise SkipEntrypoint(f"optax unavailable: {exc}")
+    import numpy as np
+    from ..learn.trainer import make_finetune_step
+    a = _gnn_arrays()
+    params = _params()
+    anchor = _params()
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    step = make_finetune_step(tx)
+    batch = {k: a[k] for k in (
+        "features", "node_kind", "node_mask", "edge_src", "edge_dst",
+        "edge_rel", "edge_mask", "incident_nodes")}
+    batch["labels"] = np.zeros(N_INC, np.int32)
+    batch["label_mask"] = a["incident_mask"]
+    fn = partial(step, rel_offsets=a["rel_offsets"], slices_sorted=True)
+    return fn, (params, opt_state, anchor, np.float32(1e-3), batch)
+
+
 def _sharded_build(halo: str):
     def build():
         import jax
@@ -560,6 +590,18 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
                       expect_sorted_scatter=True),
         notes="value_and_grad + adam through the bucketed kernel (gather "
               "transposes are 1-D scatter-adds)"),
+    Entrypoint(
+        "learn.finetune_step", _finetune_step_build,
+        InvariantSpec(max_intermediate_bytes=HOT_BUDGET,
+                      expect_sorted_scatter=True),
+        notes="graft-evolve online fine-tune: offline-step loss + "
+              "proximal anchor 0.5*w*||theta - serving||^2 (elementwise "
+              "only); donates (params, opt_state), the anchor is read "
+              "per step; explicit zero-collective CostSpec — the "
+              "background trainer must never go distributed implicitly "
+              "(the sharded tier is the separately-pinned "
+              "sharded_gnn.loss.ring entrypoint)",
+        cost=COST_DEFAULT),
     Entrypoint(
         "sharded_gnn.loss.allgather.bucketed", _sharded_build("allgather"),
         InvariantSpec(max_intermediate_bytes=HOT_BUDGET,

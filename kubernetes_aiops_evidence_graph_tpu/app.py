@@ -73,6 +73,12 @@ class AiopsApp:
         self.rate_limiter = RateLimiter(self.settings)
         self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
                                      settings=self.settings, dedup=self.dedup)
+        # graft-evolve (learn/): the online learning loop, attached to the
+        # worker's resident GNN scorer once serving resolves it. Built on
+        # a background thread at start() — scorer construction tensorizes
+        # the store, and learning must never delay first-serve.
+        self.learner = None
+        self._learner_thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._loop_thread: threading.Thread | None = None
         self._server = None
@@ -95,13 +101,43 @@ class AiopsApp:
             target=self._server.serve_forever, daemon=True, name="kaeg-http")
         self._server_thread.start()
         bound = self._server.server_address[1]
+        if self.settings.learn_enabled:
+            self._learner_thread = threading.Thread(
+                target=self._start_learner, name="kaeg-learn-boot",
+                daemon=False)
+            self._learner_thread.start()
         log.info("app_started", port=bound)
         return bound
+
+    def _start_learner(self) -> None:
+        """Resolve the resident GNN scorer (may build it — off the event
+        loop and off the serving path) and start the online learning
+        loop. Any backend without a swappable scorer leaves learning off,
+        loudly."""
+        try:
+            scorer = self.worker.serving_scorer()
+            if scorer is None or not hasattr(scorer, "swap_params"):
+                log.warning("learn_requires_gnn_scorer",
+                            rca_backend=self.settings.rca_backend)
+                return
+            from .learn import OnlineLearner
+            self.learner = OnlineLearner(self.db, [scorer],
+                                         settings=self.settings)
+            self.learner.start()
+            log.info("learner_started",
+                     interval_s=self.settings.learn_interval_s)
+        except Exception as exc:  # graft-audit: allow[broad-except] learning is strictly additive: a failed learner boot must never take serving down
+            log.error("learner_start_failed", error=str(exc))
 
     def stop(self) -> None:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+        if self._learner_thread is not None:
+            self._learner_thread.join(timeout=30)
+            self._learner_thread = None
+        if self.learner is not None:
+            self.learner.stop()
         if self._loop is not None:
             try:
                 asyncio.run_coroutine_threadsafe(
@@ -157,6 +193,15 @@ class AiopsApp:
 
     def workflow_status(self, incident_id: str | UUID) -> dict:
         return self.worker.engine.status(f"incident-{incident_id}")
+
+    def learning_status(self) -> dict:
+        """GET /api/v1/learning: the online-learning loop's observable
+        state — buffer occupancy, last gate eval, swap generation."""
+        l = self.learner
+        if l is None:
+            return {"enabled": bool(self.settings.learn_enabled),
+                    "running": False}
+        return {"enabled": True, **l.status()}
 
 
 def main() -> None:  # pragma: no cover - manual entrypoint

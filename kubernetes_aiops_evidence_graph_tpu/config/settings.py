@@ -169,6 +169,47 @@ class Settings:
     # deterministic seeded jitter (workflow/engine.RetryPolicy semantics)
     shield_retry_attempts: int = 2
     shield_retry_backoff_s: float = 0.05
+    # graft-evolve (learn/): the online learning loop — production
+    # verdicts (verification outcomes, operator HypothesisFeedback,
+    # rule-confirmed verdicts) harvested into labeled episodes, a
+    # background fine-tune from the live checkpoint, an eval GATE
+    # (candidate holdout top-1 must be >= the serving checkpoint's or it
+    # is discarded, counted in aiops_learn_gate_rejects_total), and a hot
+    # checkpoint swap into the serving executors at a generation boundary
+    # of the double-buffered queue (in-flight ticks complete on old
+    # params; same shapes => no retrace). Swaps are journaled through the
+    # shield WAL when the scorer is shielded, so crash recovery replays
+    # onto the correct params generation.
+    learn_enabled: bool = False
+    learn_interval_s: float = 30.0       # background loop cadence
+    learn_steps: int = 120               # fine-tune steps per cycle
+    learn_lr: float = 1e-3
+    # proximal anchor: fine-tune loss carries 0.5*w*||theta - serving||^2
+    # pulling the candidate toward the live checkpoint — the parameter-
+    # space half of the anti-forgetting story (the replay mix is the
+    # data-space half)
+    learn_anchor_weight: float = 1e-3
+    learn_min_episodes: int = 2          # buffer floor before training
+    learn_buffer_cap: int = 64           # dedup'd replay buffer episodes
+    # simulator episodes mixed into every fine-tune (anti-forgetting) and
+    # the simulator holdout suite the gate evaluates against
+    learn_sim_episodes: int = 4
+    learn_sim_holdout: int = 2
+    learn_sim_pods: int = 96
+    learn_sim_incidents: int = 6
+    # every Nth harvested production episode is HELD OUT of training and
+    # joins the gate's production holdout slice instead
+    learn_holdout_every: int = 4
+    # label fallback: rule-confirmed verdicts (rules-backend top-1 at
+    # confidence >= learn_weak_confidence) label incidents that never got
+    # operator feedback or a verification outcome
+    learn_weak_labels: bool = True
+    learn_weak_confidence: float = 0.9
+    learn_checkpoint_dir: str = ""       # "" -> .kaeg_learn/<pid>
+    # >1: the fine-tune drives the existing sharded train step
+    # (parallel/sharded_gnn.make_sharded_train_step) on a (1 x D) data
+    # mesh — forced host devices on CPU, same fallback as serving
+    learn_mesh_shards: int = 1
     mesh_dp: int = 1                               # data-parallel axis (incidents)
     mesh_graph: int = 1                            # graph-parallel axis (node shards)
     node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)

@@ -312,6 +312,39 @@ class ApiHandler(BaseHTTPRequestHandler):
     def list_feedback(self, hypothesis_id: str):
         self._json(200, {"feedback": self.app.db.feedback_for(hypothesis_id)})
 
+    # -- online learning (graft-evolve, learn/) ----------------------------
+    # The operator surface of the loop: POST /api/v1/feedback feeds it
+    # (the flat-body twin of the per-hypothesis route above — the
+    # hypothesis id rides in the body, which is what operator tooling
+    # posting from an alert annotation wants), GET /api/v1/learning
+    # observes it (buffer occupancy, last gate eval, swap generation).
+
+    @route("POST", "/api/v1/feedback")
+    def submit_feedback_body(self):
+        from pydantic import ValidationError
+
+        from ..models import HypothesisFeedback
+        body = self._body()
+        try:
+            fb = HypothesisFeedback(**body)
+        except (ValidationError, TypeError) as exc:
+            self._json(400, {"error": str(exc)})
+            return
+        # orphan rejection rides the storage layer's atomic
+        # existence-check-and-insert (insert_feedback's False path):
+        # feedback for a hypothesis re-analysis deleted must 404, not
+        # silently poison the learning loop's label harvest
+        if not self.app.db.insert_feedback(fb):
+            self._json(404, {"error": "unknown hypothesis",
+                             "hypothesis_id": str(fb.hypothesis_id)})
+            return
+        self._json(201, {"recorded": True,
+                         "hypothesis_id": str(fb.hypothesis_id)})
+
+    @route("GET", "/api/v1/learning")
+    def learning_status(self):
+        self._json(200, self.app.learning_status())
+
     # -- traces (observability; new) --------------------------------------
 
     @route("GET", "/api/v1/traces")

@@ -1,0 +1,27 @@
+"""graft-evolve: online learning from production verdicts (ROADMAP 5).
+
+The serving path produces ground truth the offline checkpoint never saw —
+``VerificationResult.success`` (did the remediation actually fix it),
+operator :class:`~..models.HypothesisFeedback`
+(``was_correct``/``actual_root_cause``), and rule-confirmed verdicts. This
+package closes the loop (KGroot/Groot precedent, PAPERS.md):
+
+* :mod:`.episodes` — harvest those labels from the durable store, replay
+  recent incident windows into labeled training episodes, and hold them
+  in a bounded dedup'd replay buffer mixed with simulator episodes
+  (anti-forgetting);
+* :mod:`.trainer` — the background fine-tune from the live checkpoint
+  (proximal anchor to the serving params; optionally the existing
+  sharded train step on a (1 × D) data mesh) and the eval GATE;
+* :mod:`.loop` — :class:`OnlineLearner`, the orchestrator: harvest →
+  train → gate → hot swap into the serving executors (atomic across
+  tenants, WAL-journaled through the shield) with post-swap rollback.
+"""
+from .episodes import ReplayBuffer, build_episode, harvest_labels
+from .loop import OnlineLearner
+from .trainer import finetune, make_finetune_step
+
+__all__ = [
+    "ReplayBuffer", "build_episode", "harvest_labels",
+    "OnlineLearner", "finetune", "make_finetune_step",
+]

@@ -1,0 +1,208 @@
+"""Background fine-tune from the live checkpoint + the eval gate.
+
+Two execution tiers, one schedule:
+
+* **Single-device** (default): :func:`make_finetune_step` — the stock
+  relation-bucketed loss plus a PROXIMAL ANCHOR ``0.5·w·‖θ − θ_serve‖²``
+  pulling the candidate toward the serving checkpoint. The anchor is the
+  parameter-space half of the anti-forgetting story (the replay mix of
+  simulator episodes is the data-space half). Params/opt_state are
+  donated, anchor is not (it is re-read every step); ``rel_offsets`` /
+  ``slices_sorted`` are static jit keys exactly like the offline step —
+  the per-relation capacity ladder bounds the compile count. Registered
+  as the ``learn.finetune_step`` audit entrypoint (analysis/registry.py)
+  with its donation signature in ``JIT_DECLARATIONS``.
+
+* **Sharded** (``settings.learn_mesh_shards > 1``): the EXISTING
+  ``parallel/sharded_gnn.make_sharded_train_step`` on a (1 × D) data
+  mesh — episodes partition through ``parallel/partition.py`` (label
+  mask substituted for the incident mask so partially-labeled production
+  episodes never train on garbage rows). Forced host devices make this
+  hermetic on CPU, same fallback serving uses.
+
+The **gate** is deliberately boring: candidate holdout top-1 (simulator
+suite + the held production slice) must be ``>=`` the serving
+checkpoint's on the SAME holdout, and every candidate leaf must be
+finite. A candidate that fails is discarded and counted
+(``aiops_learn_gate_rejects_total``) — never swapped.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..observability import get_logger
+from ..observability import metrics as obs_metrics
+from ..rca import gnn
+
+log = get_logger("learn.trainer")
+
+
+def make_finetune_step(tx):
+    """jitted ``(params, opt_state, anchor, anchor_weight, batch) ->
+    (params, opt_state, loss)`` — the online fine-tune step (see module
+    docstring). ``anchor_weight`` is a traced scalar (a per-cycle knob
+    must not mint a compile); the anchor tree is read-only."""
+
+    # params/opt_state are consumed and rebound every step (the offline
+    # step's donation discipline, rca/gnn.py); the anchor is NOT donated —
+    # every step of a cycle reads the same serving checkpoint
+    @partial(jax.jit, static_argnames=("rel_offsets", "slices_sorted"),
+             donate_argnums=(0, 1))
+    def step(params, opt_state, anchor, anchor_weight, batch,
+             rel_offsets=None, slices_sorted: bool = False):
+        def total_loss(p):
+            data = gnn.loss_fn(
+                p,
+                batch["features"], batch["node_kind"], batch["node_mask"],
+                batch["edge_src"], batch["edge_dst"], batch["edge_rel"],
+                batch["edge_mask"], batch["incident_nodes"],
+                batch["labels"], batch["label_mask"],
+                rel_offsets=rel_offsets, slices_sorted=slices_sorted)
+            prox = jax.tree_util.tree_reduce(
+                lambda a, b: a + b,
+                jax.tree_util.tree_map(
+                    lambda x, y: jnp.sum(jnp.square(x - y)), p, anchor))
+            return data + 0.5 * anchor_weight * prox
+        loss, grads = jax.value_and_grad(total_loss)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def _clean_batch(ep: dict) -> tuple[dict, "tuple | None"]:
+    """(jit-safe batch pytree, static rel_offsets) — snapshot and tuple
+    statics stripped, exactly the offline trainer's discipline."""
+    offs = tuple(ep.get("rel_offsets") or ()) or None
+    batch = {k: v for k, v in ep.items()
+             if k in ("features", "node_kind", "node_mask", "edge_src",
+                      "edge_dst", "edge_rel", "edge_mask",
+                      "incident_nodes", "labels", "label_mask")}
+    return batch, offs
+
+
+def _interleave(prod: list, sim: list, steps: int) -> list:
+    """The fine-tune schedule: production and simulator episodes
+    alternate (anti-forgetting), cycling each list independently."""
+    out = []
+    for s in range(steps):
+        pool = prod if (s % 2 == 0 or not sim) else sim
+        if not pool:
+            pool = sim or prod
+        out.append(pool[(s // 2) % len(pool)])
+    return out
+
+
+def finetune(serving_params, episodes: list, sim_episodes: list,
+             steps: int, lr: float, anchor_weight: float,
+             mesh_shards: int = 1) -> dict:
+    """Fine-tune a candidate from ``serving_params`` over the interleaved
+    production/simulator schedule. Returns ``{"params", "steps",
+    "final_loss", "sharded"}`` — the candidate is a FRESH tree (the
+    serving tree is never mutated; the swap is the only way a candidate
+    reaches serving)."""
+    import optax
+    if not episodes and not sim_episodes:
+        raise ValueError("finetune needs at least one episode")
+    tx = optax.adam(lr)
+    schedule = _interleave(episodes, sim_episodes, steps)
+    if mesh_shards > 1:
+        mesh = _data_mesh(mesh_shards)
+        if mesh is not None:
+            return _finetune_sharded(serving_params, schedule, tx, mesh)
+        log.warning("learn_mesh_unavailable", shards=mesh_shards)
+
+    step = make_finetune_step(tx)
+    anchor = jax.tree_util.tree_map(jnp.asarray, serving_params)
+    params = jax.tree_util.tree_map(jnp.array, anchor)   # fresh candidate
+    opt_state = tx.init(params)
+    w = jnp.float32(anchor_weight)
+    loss = jnp.float32(0.0)
+    for ep in schedule:
+        batch, offs = _clean_batch(ep)
+        params, opt_state, loss = step(
+            params, opt_state, anchor, w, batch,
+            rel_offsets=offs, slices_sorted=offs is not None)
+        obs_metrics.LEARN_TRAIN_STEPS.inc()
+    return {"params": params, "steps": len(schedule),
+            "final_loss": float(jax.device_get(loss)), "sharded": False}
+
+
+def _data_mesh(shards: int):
+    """(1 × shards) data mesh for the sharded fine-tune, with the same
+    forced-host-device fallback serving uses (parallel/mesh.py)."""
+    from ..parallel.mesh import ensure_host_devices, make_mesh
+    if not ensure_host_devices(shards):
+        return None
+    devices = jax.devices()
+    if len(devices) < shards:
+        return None
+    return make_mesh(dp=1, graph=shards, devices=devices[:shards])
+
+
+def _finetune_sharded(serving_params, schedule: list, tx, mesh) -> dict:
+    """Drive the EXISTING sharded train step (parallel/sharded_gnn.py)
+    over partitioned episodes. Episodes must carry their snapshot;
+    the label mask substitutes for the incident mask so unlabeled rows
+    never contribute loss (partition.py reads the snapshot's mask)."""
+    import dataclasses
+    from ..parallel.partition import partition_snapshot
+    from ..parallel.sharded_gnn import (device_put_partitioned,
+                                        make_sharded_train_step)
+    graph = mesh.shape["graph"]
+    params = jax.tree_util.tree_map(jnp.array, serving_params)
+    opt_state = tx.init(params)
+    steps_by_offs: dict = {}
+    loss = jnp.float32(0.0)
+    ran = 0
+    for ep in schedule:
+        snap = ep.get("snapshot")
+        if snap is None or snap.padded_nodes % graph:
+            continue   # logged once below; the single-device tier covers it
+        labeled = dataclasses.replace(
+            snap, incident_mask=np.asarray(ep["label_mask"], np.float32))
+        part = partition_snapshot(labeled, dp=1, graph=graph,
+                                  labels=np.asarray(ep["labels"]))
+        key = part.rel_offsets
+        step = steps_by_offs.get(key)
+        if step is None:
+            step = steps_by_offs[key] = make_sharded_train_step(
+                mesh, tx, halo="ring", rel_offsets=key)
+        params, opt_state, loss = step(
+            params, opt_state, *device_put_partitioned(part, mesh))
+        obs_metrics.LEARN_TRAIN_STEPS.inc()
+        ran += 1
+    if not ran:
+        raise ValueError(
+            "no episode was partitionable over the learn mesh "
+            "(padded_nodes must divide by learn_mesh_shards)")
+    return {"params": params, "steps": ran,
+            "final_loss": float(jax.device_get(loss)), "sharded": True}
+
+
+def params_finite(params) -> bool:
+    """Host check that every candidate leaf is finite — a poisoned
+    candidate must die at the gate, not at the verdict boundary."""
+    for leaf in jax.tree_util.tree_leaves(params):
+        if not np.isfinite(np.asarray(jax.device_get(leaf))).all():
+            return False
+    return True
+
+
+def gate_eval(params, holdout: list) -> float:
+    """Holdout top-1 for the gate: the offline trainer's evaluate() over
+    jit-safe views of the holdout episodes (one device_get per batch)."""
+    from ..rca.train import evaluate
+    batches = []
+    for ep in holdout:
+        batch, offs = _clean_batch(ep)
+        if offs is not None:
+            batch["rel_offsets"] = offs   # forward_batch reads it
+        batches.append(batch)
+    return evaluate(params, batches)

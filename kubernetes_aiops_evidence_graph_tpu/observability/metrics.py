@@ -289,6 +289,40 @@ SHIELD_NONFINITE_VERDICTS = REGISTRY.counter(
     "Verdict fetches rejected by the finite guard (NaN/inf would have "
     "been served), by path label")
 
+# graft-evolve instrumentation (learn/): the online learning loop.
+# Every stage of the verdicts→checkpoint pipeline is counted — harvested
+# episodes, buffer occupancy, fine-tune steps, the gate's eval accuracy,
+# and the swap/rollback/gate-reject outcomes — so "the model silently got
+# worse" is not a failure mode this loop can have.
+LEARN_EPISODES_HARVESTED = REGISTRY.counter(
+    "aiops_learn_episodes_harvested_total",
+    "Labeled incidents harvested into replay-buffer episodes, by label "
+    "source (feedback | verification | weak_rule)")
+LEARN_BUFFER_SIZE = REGISTRY.gauge(
+    "aiops_learn_buffer_size",
+    "Dedup'd production episodes resident in the replay buffer")
+LEARN_TRAIN_STEPS = REGISTRY.counter(
+    "aiops_learn_train_steps_total",
+    "Fine-tune train steps executed by the background trainer")
+LEARN_EVAL_TOP1 = REGISTRY.gauge(
+    "aiops_learn_eval_top1",
+    "Gate holdout top-1 accuracy (simulator suite + held production "
+    "slice), by params label (candidate | serving)")
+LEARN_SWAPS = REGISTRY.counter(
+    "aiops_learn_swaps_total",
+    "Hot checkpoint swaps landed into the serving executors")
+LEARN_ROLLBACKS = REGISTRY.counter(
+    "aiops_learn_rollbacks_total",
+    "Post-swap rollbacks to the previous params generation (nonfinite "
+    "verdicts or accuracy regression after a swap)")
+LEARN_GATE_REJECTS = REGISTRY.counter(
+    "aiops_learn_gate_rejects_total",
+    "Fine-tuned candidates discarded by the eval gate (holdout top-1 "
+    "below the serving checkpoint's) — counted, never swapped")
+LEARN_GENERATION = REGISTRY.gauge(
+    "aiops_learn_params_generation",
+    "Params generation currently serving (0 = the offline checkpoint)")
+
 # graft-scope instrumentation (observability/scope.py): the end-to-end
 # serving latency story — webhook→verdict SLO histograms, per-tick stage
 # splits at the host boundaries, telemetry self-accounting (dropped

@@ -119,7 +119,8 @@ class TickSpan:
 
     __slots__ = ("tick_id", "t0", "marks", "queue_wait_s", "coalesced",
                  "pending", "shard_rows", "tier", "flags", "depth",
-                 "backend", "fetched", "batch_incidents", "tenants")
+                 "backend", "fetched", "batch_incidents", "tenants",
+                 "params_gen")
 
     def __init__(self, tick_id: int, backend: str, depth: int,
                  tier: str, queue_wait_s: float) -> None:
@@ -140,6 +141,11 @@ class TickSpan:
         # passes must be visible in forensics, not just in the histogram
         self.batch_incidents = 0
         self.tenants = 1
+        # graft-evolve: the params generation this tick dispatched
+        # against (0 = the offline checkpoint) — stamped by the scorer at
+        # dispatch so the flight ring shows exactly which ticks straddled
+        # a hot checkpoint swap
+        self.params_gen = 0
 
     def mark(self, stage: str) -> None:
         self.marks.append((stage, time.monotonic()))
@@ -175,6 +181,7 @@ class TickSpan:
             "flags": list(self.flags),
             "batch_incidents": self.batch_incidents,
             "tenants": self.tenants,
+            "params_gen": self.params_gen,
             "t_epoch_s": round(_epoch_of(self.t0), 6),
         }
 

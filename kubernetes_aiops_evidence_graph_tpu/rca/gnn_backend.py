@@ -37,6 +37,55 @@ def _shipped_checkpoint() -> str | None:
     return str(p) if p.is_dir() else None
 
 
+class CheckpointError(ValueError):
+    """A checkpoint could not be loaded or fails the serving contract
+    (corrupt files, legacy pre-relation-aware layout, missing keys).
+    ValueError subclass so existing callers' except clauses still match;
+    the workflow worker catches it to fall back to the rules serving tier
+    instead of crashing the worker — with graft-evolve hot-swapping
+    checkpoints in and out, load failures are an operational event, not a
+    programming error."""
+
+
+def load_validated_checkpoint(path: str) -> gnn.Params:
+    """Load an orbax checkpoint and validate it against the serving
+    model contract, normalizing every failure mode — unreadable/corrupt
+    files (orbax raises a zoo of exception types), a payload that is not
+    a params tree, or the legacy pre-relation-aware layout — into one
+    clear :class:`CheckpointError`. The single load path for the backend,
+    the streaming scorer, and the online-learning loop's swap/recovery
+    reloads (hot swap multiplies how often checkpoints are loaded, so
+    this error path is load-bearing, not defensive)."""
+    from .train import load_checkpoint
+    try:
+        restored = load_checkpoint(path)
+    except Exception as exc:  # catch-and-rethrow: orbax load failures span
+        # OSError/ValueError/KeyError/TypeError and plugin-specific types;
+        # all mean the same operational thing, normalized below
+        raise CheckpointError(
+            f"checkpoint at {path} is unreadable ({type(exc).__name__}: "
+            f"{exc}): retrain with rca/train.py or point "
+            "KAEG_GNN_CHECKPOINT at a valid checkpoint") from exc
+    params = (restored or {}).get("params") if isinstance(restored, dict) \
+        else None
+    if not isinstance(params, dict) or "embed_w" not in params:
+        raise CheckpointError(
+            f"checkpoint at {path} does not contain a GNN params tree "
+            "(expected a {'params': {...}} orbax payload written by "
+            "rca/train.py)")
+    layers = params.get("layers") or []
+    if layers and "w_rel" not in layers[0]:
+        # pre-relation-aware checkpoints (round ≤4: per-layer "w_msg")
+        # would otherwise surface as a bare KeyError deep inside jit
+        # tracing (code-review r5)
+        raise CheckpointError(
+            f"checkpoint at {path} predates the relation-aware GNN "
+            "(layers carry 'w_msg', expected 'w_rel'): retrain with "
+            "rca/train.py or point KAEG_GNN_CHECKPOINT at a current "
+            "checkpoint")
+    return params
+
+
 class GnnRcaBackend:
     name = "gnn"
 
@@ -47,22 +96,11 @@ class GnnRcaBackend:
         if params is None:
             path = cfg.gnn_checkpoint or _shipped_checkpoint()
             if not path:
-                raise ValueError(
+                raise CheckpointError(
                     "rca_backend=gnn needs trained parameters: set "
                     "KAEG_GNN_CHECKPOINT (written by rca/train.py) or pass "
                     "params=")
-            from .train import load_checkpoint
-            params = load_checkpoint(path)["params"]
-            layers = params.get("layers") or []
-            if layers and "w_rel" not in layers[0]:
-                # pre-relation-aware checkpoints (round ≤4: per-layer
-                # "w_msg") would otherwise surface as a bare KeyError deep
-                # inside jit tracing (code-review r5)
-                raise ValueError(
-                    f"checkpoint at {path} predates the relation-aware GNN "
-                    "(layers carry 'w_msg', expected 'w_rel'): retrain with "
-                    "rca/train.py or point KAEG_GNN_CHECKPOINT at a current "
-                    "checkpoint")
+            params = load_validated_checkpoint(path)
         self.params = params
         # kernel selection is per-batch via gnn.forward_batch: snapshots
         # carry the relation-bucketed layout (rel_offsets) and take the

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 
 from ..graph.store import EvidenceGraphStore
 from ..observability import get_logger
+from ..observability import metrics as obs_metrics
 from ..observability import scope as obs_scope
 from .ruleset import NUM_RULES
 from .streaming import StreamingScorer, _DELTA_BUCKETS
@@ -143,6 +144,12 @@ class GnnStreamingScorer(StreamingScorer):
             # given, not the global env-derived ones (code-review r5)
             params = GnnRcaBackend(settings=settings).params
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        # graft-evolve: previous (params, generation) kept for one swap so
+        # a post-swap nonfinite/accuracy regression can roll back without
+        # a checkpoint reload; _params_source names the checkpoint the
+        # current generation came from ("" = constructor params)
+        self._params_prev: "tuple | None" = None
+        self._params_source = ""
         # graft-fleet: a mesh with a real ``graph`` axis is served by the
         # sharded GNN tick (parallel/sharded_streaming.sharded_gnn_tick:
         # per-shard edge regions, ring-halo message pass). A dp-only mesh
@@ -569,8 +576,82 @@ class GnnStreamingScorer(StreamingScorer):
     def _tick_handles(self, out: tuple) -> tuple:
         """The pipeline queue tracks the GNN tick's outputs: in gnn mode
         the base rules handles are never fetched, so the GNN probs are
-        both the completion signal and the deferred-fetch surface."""
+        both the completion signal and the deferred-fetch surface. The
+        tuple leads with the params GENERATION the tick dispatched
+        against (graft-evolve): a deferred newest-tick fetch after a hot
+        swap must report the generation that actually produced the
+        verdict, not the one currently installed. The probs stay LAST —
+        every pipeline readiness/stall probe reads ``handles[-1]``."""
         return self._last_gnn
+
+    # -- graft-evolve: hot checkpoint swap ---------------------------------
+
+    def _swap_params_locked(self, params, generation: int,
+                            source: str = "") -> None:
+        """Install new params under an ALREADY-HELD ``serve_lock`` — the
+        multi-scorer atomic swap (rca/surge.swap_tenants_atomically)
+        acquires every tenant's lock first, then flips each scorer
+        through this seam. The swap is a reference replacement at a queue
+        generation boundary: dispatch() reads ``self._params`` under
+        ``serve_lock``, so in-flight ticks keep the OLD tree (they
+        captured it at their own dispatch) and complete on it, while the
+        next dispatch passes the new tree — same shapes/dtypes, so the
+        jitted tick reuses its compiled executable (no retrace). Shape or
+        structure drift is rejected up front: silently retracing the
+        serving tick mid-stream is exactly the hiccup warm() exists to
+        prevent."""
+        new = jax.tree_util.tree_map(jnp.asarray, params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self._params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new)
+        if old_def != new_def or any(
+                a.shape != b.shape or a.dtype != b.dtype
+                for a, b in zip(old_leaves, new_leaves)):
+            raise ValueError(
+                "hot swap rejected: candidate params tree/shapes differ "
+                "from the serving checkpoint (a swap must reuse the "
+                "compiled tick — retrain with the serving model config)")
+        self._params_prev = (self._params, self.params_generation,
+                             self._params_source)
+        self._params = new
+        self.params_generation = int(generation)
+        self._params_source = source
+        obs_metrics.LEARN_GENERATION.set(float(self.params_generation))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "params_swap", generation=self.params_generation,
+            source=source, backend="gnn")
+        log.info("params_swapped", generation=self.params_generation,
+                 source=source)
+
+    def swap_params(self, params, generation: "int | None" = None,
+                    source: str = "") -> int:
+        """Hot-swap the serving checkpoint without dropping in-flight
+        ticks (see :meth:`_swap_params_locked`). Returns the new
+        generation. Unshielded entry point — the ShieldedScorer shadows
+        this with a WAL-journaled variant so crash recovery replays onto
+        the correct generation."""
+        with self.serve_lock:
+            gen = (self.params_generation + 1 if generation is None
+                   else int(generation))
+            self._swap_params_locked(params, gen, source=source)
+        obs_metrics.LEARN_SWAPS.inc()
+        return gen
+
+    def rollback_params(self) -> "int | None":
+        """Revert to the previous params generation (post-swap nonfinite
+        or accuracy regression). Returns the restored generation, or None
+        when there is nothing to roll back to. The restored tree serves
+        under a FRESH (monotonically advanced) generation number so the
+        shield WAL replay stays ordered — replay applies any swap record
+        newer than the state it restored."""
+        with self.serve_lock:
+            if self._params_prev is None:
+                return None
+            params, _old_gen, source = self._params_prev
+            gen = self.params_generation + 1
+            self._swap_params_locked(params, gen, source=source)
+            self._params_prev = None   # one-deep: no rollback ping-pong
+        obs_metrics.LEARN_ROLLBACKS.inc()
+        return gen
 
     # -- graft-shield seams (snapshot/restore) -----------------------------
 
@@ -578,18 +659,38 @@ class GnnStreamingScorer(StreamingScorer):
         "_gnn_seq", "_rel_offsets", "_slices_sorted",
         "_edge_slot", "_node_edges", "_free_edge_slots", "_pending_edges",
         "_mirror_sharded", "_pe_shard",
+        # graft-evolve: the generation/source stamp travels with the
+        # snapshot so a restore serves the generation it captured (the
+        # params VALUES ride in the packed device arrays below)
+        "params_generation", "_params_source",
     )
 
+    _MIRROR_ARRAYS = 6   # kind/nmask + the four edge arrays
+
     def _resident_arrays(self) -> list:
+        # the serving params are part of the resident state (graft-evolve):
+        # packing their leaves into the snapshot makes crash recovery
+        # restore the EXACT swapped checkpoint bit-for-bit — no reload
+        # from a checkpoint file that may have moved on
+        leaves = jax.tree_util.tree_leaves(self._params)
         return super()._resident_arrays() + [
             self._kind_dev, self._nmask_dev, self._esrc_dev,
-            self._edst_dev, self._erel_dev, self._emask_dev]
+            self._edst_dev, self._erel_dev, self._emask_dev] + leaves
 
     def _adopt_resident(self, parts: tuple) -> None:
         super()._adopt_resident(parts)
+        m = 4 + self._MIRROR_ARRAYS
         (self._kind_dev, self._nmask_dev, self._esrc_dev, self._edst_dev,
          self._erel_dev, self._emask_dev) = (jnp.asarray(p)
-                                             for p in parts[4:])
+                                             for p in parts[4:m])
+        if len(parts) > m:
+            # params leaves packed after the mirrors: unflatten with the
+            # CURRENT tree structure (same model config by construction —
+            # the shield matches scorer class before restoring)
+            treedef = jax.tree_util.tree_structure(self._params)
+            self._params = jax.tree_util.tree_unflatten(
+                treedef, [jnp.asarray(p) for p in parts[m:]])
+            self._params_prev = None
         self._last_gnn = None
         # the base call placed only ITS arrays (the mirror handles still
         # held pre-restore buffers then); re-place now that the restored
@@ -656,7 +757,7 @@ class GnnStreamingScorer(StreamingScorer):
              probs) = _gnn_tick(
                 *args, pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
                 **statics)
-        self._last_gnn = (logits, probs)
+        self._last_gnn = (self.params_generation, logits, probs)
         if span is not None:
             span.mark("gnn_dispatch")
         return out
@@ -682,17 +783,21 @@ class GnnStreamingScorer(StreamingScorer):
         """GnnRcaBackend.score_snapshot-shaped raw dict for live
         incidents. The base rescore()/rescore_newest() drive this —
         ``handles`` is this scorer's ``_tick_handles`` surface
-        ``(logits, probs)`` and only the probs pay the readback. Same
-        caller-boundary contract as the rules fetch: exactly one
-        device_get, dispatch/fetch timings split."""
+        ``(params_gen, logits, probs)`` and only the probs pay the
+        readback. Same caller-boundary contract as the rules fetch:
+        exactly one device_get, dispatch/fetch timings split. The
+        generation reported is the one the FETCHED tick dispatched
+        against — after a hot swap, a deferred newest-tick fetch may
+        legitimately serve the previous generation (in-flight ticks
+        complete on old params)."""
         import time
-        from ..observability import metrics as obs_metrics
         t2 = time.perf_counter()
+        tick_gen = int(handles[0])
         self._fault_point("fetch")
         if span is not None:
-            jax.block_until_ready(handles[1])
+            jax.block_until_ready(handles[-1])
             span.mark("execute")
-        probs = np.asarray(jax.device_get(handles[1]))
+        probs = np.asarray(jax.device_get(handles[-1]))
         fetch_s = time.perf_counter() - t2
         if span is not None:
             span.mark("fetch")
@@ -716,6 +821,7 @@ class GnnStreamingScorer(StreamingScorer):
             "dispatch_seconds": dispatch_s,
             "fetch_seconds": fetch_s,
             "device_seconds": queue_wait_s + dispatch_s + fetch_s,
+            "params_generation": tick_gen,
             **stats,
         }
 

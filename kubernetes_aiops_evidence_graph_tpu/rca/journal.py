@@ -205,12 +205,27 @@ class DeltaJournal:
                 self._wal_f = open(self.wal_path, "ab")
         return batches, torn
 
-    def compact(self, through_seq: int) -> None:
+    def compact(self, through_seq: int,
+                through_params_gen: "int | None" = None) -> None:
         """Drop batches fully covered by a snapshot at ``through_seq``
         (rewrite-and-replace, atomic): after a snapshot the prefix is dead
-        weight and replay cost must stay O(suffix), not O(history)."""
+        weight and replay cost must stay O(suffix), not O(history).
+
+        ``params_swap`` records (graft-evolve: a hot checkpoint swap
+        journaled ahead of its application) are NOT covered by a store-seq
+        horizon — a swap can land at the same store seq as a snapshot
+        captured BEFORE it, and dropping its record would recover the old
+        generation. They compact by their own monotonic generation:
+        records at generations the snapshot already carries
+        (``<= through_params_gen``) are dead weight; newer ones survive.
+        ``None`` keeps every swap record (a shield that never learned the
+        snapshot's generation must not guess)."""
         batches, _ = self.read()
-        keep = [b for b in batches if b.seq_hi > through_seq]
+        keep = [b for b in batches
+                if (b.meta.get("generation", 0) > through_params_gen
+                    if b.kind == "params_swap"
+                    and through_params_gen is not None
+                    else b.seq_hi > through_seq or b.kind == "params_swap")]
         tmp = self.wal_path + ".tmp"
         with open(tmp, "wb") as f:
             for b in keep:

@@ -236,6 +236,65 @@ class ShieldedScorer:
         silently breaking crash recovery."""
         return self.tick()
 
+    def swap_params(self, params, source: str = "") -> int:
+        """graft-evolve: hot checkpoint swap, WAL-journaled BEFORE it is
+        applied (the crash-consistency invariant — same order as delta
+        batches). MUST shadow the scorer's swap_params: a ``__getattr__``
+        fallthrough would swap without a journal record and recovery
+        would replay post-swap deltas onto the pre-swap generation. The
+        record carries the params LEAVES themselves (a few hundred KB,
+        swaps are rare), so replay restores the exact swapped values
+        bit-for-bit without depending on a checkpoint file that may have
+        been pruned. Returns the new generation."""
+        with self._lock:
+            s = self.scorer
+            gen = int(getattr(s, "params_generation", 0)) + 1
+            leaves = [np.asarray(x)
+                      for x in jax.tree_util.tree_leaves(params)]
+            seq = int(s._synced_seq)
+            self.journal.append((), seq, seq, kind="params_swap",
+                                force_sync=True, generation=gen,
+                                leaves=leaves, source=source)
+            s.swap_params(params, generation=gen, source=source)
+            obs_scope.FLIGHT_RECORDER.note_event(
+                "params_swap_journaled", generation=gen, seq=seq)
+            return gen
+
+    def rollback_params(self) -> "int | None":
+        """Journaled rollback to the previous generation (post-swap
+        nonfinite/regression). The restored tree is re-journaled as a
+        fresh swap record so replay ordering stays monotonic."""
+        with self._lock:
+            s = self.scorer
+            prev = getattr(s, "_params_prev", None)
+            if prev is None:
+                return None
+            gen = s.rollback_params()
+            if gen is None:
+                return None
+            leaves = [np.asarray(x)
+                      for x in jax.tree_util.tree_leaves(s._params)]
+            seq = int(s._synced_seq)
+            self.journal.append((), seq, seq, kind="params_swap",
+                                force_sync=True, generation=gen,
+                                leaves=leaves, source=prev[2],
+                                rollback=True)
+            return gen
+
+    def _replay_params_swap(self, batch) -> None:
+        """Apply one WAL ``params_swap`` record during recovery: newer
+        generations than the restored state re-install their exact
+        leaves; older ones are already reflected in the snapshot."""
+        s = self.scorer
+        gen = int(batch.meta.get("generation", 0))
+        if gen <= int(getattr(s, "params_generation", 0)):
+            return
+        treedef = jax.tree_util.tree_structure(s._params)
+        params = jax.tree_util.tree_unflatten(
+            treedef, list(batch.meta["leaves"]))
+        s._swap_params_locked(params, gen,
+                              source=batch.meta.get("source", ""))
+
     def sync(self) -> dict:
         """Journal + apply only (no dispatch) — for drivers that tick
         elsewhere."""
@@ -375,6 +434,19 @@ class ShieldedScorer:
             obs_scope.FLIGHT_RECORDER.note_event(
                 "quarantined", seq_lo=lo, seq_hi=hi,
                 reason=str(exc)[:200])
+        if isinstance(exc, NonFiniteVerdict) and \
+                getattr(self.scorer, "_params_prev", None) is not None:
+            # graft-evolve: non-finite verdicts right after a hot
+            # checkpoint swap indict the FRESHEST config change first —
+            # roll the swap back (journaled, one-deep) and retry on the
+            # restored generation before walking the heavier ladder. If
+            # the rollback doesn't cure it, _params_prev is now None and
+            # the next failure escalates normally — bounded by design.
+            if self.rollback_params() is not None:
+                # (the scorer's rollback already counts itself in
+                # aiops_learn_rollbacks_total)
+                self._transition("params_rollback")
+                return
         if not suspect and state["failures"] <= self.retry.max_attempts:
             # transient, state coherent: bounded retry with seeded-jitter
             # backoff (key = store lineage + batch, so concurrent shields
@@ -530,7 +602,12 @@ class ShieldedScorer:
         self.last_capture_seconds = time.perf_counter() - t0
         state = {"epoch": self._epoch, "store_seq": store_seq,
                  "klass": type(s).__name__, "layout": layout,
-                 "flat": flat, "host": host}
+                 "flat": flat, "host": host,
+                 # graft-evolve: the generation this snapshot serves —
+                 # compaction uses it to drop only swap records the
+                 # snapshot already reflects (the packed arrays carry the
+                 # params values themselves)
+                 "params_gen": int(getattr(s, "params_generation", 0))}
         self.snapshots += 1
         self._ticks_since_snapshot = 0
         obs_metrics.SHIELD_SNAPSHOTS.inc()
@@ -545,7 +622,8 @@ class ShieldedScorer:
     def _persist_snapshot(self, state: dict, t0: float) -> int:
         try:
             nbytes = self.journal.write_snapshot(state)
-            self.journal.compact(state["store_seq"])
+            self.journal.compact(state["store_seq"],
+                                 through_params_gen=state["params_gen"])
         except (OSError, RuntimeError) as exc:
             # a failed persist leaves the previous snapshot intact; the
             # next cadence (or recovery-time rebuild) covers the gap
@@ -591,6 +669,14 @@ class ShieldedScorer:
             batches, torn = self.journal.read()
             rb0 = s.rebuilds
             for b in batches:
+                if b.kind == "params_swap":
+                    # a swap journaled after the snapshot: re-install its
+                    # exact leaves so post-swap deltas replay onto the
+                    # generation that actually served them (file order ==
+                    # live order — both appended under the shield lock)
+                    if hasattr(s, "_swap_params_locked"):
+                        self._replay_params_swap(b)
+                    continue
                 if b.kind != "deltas" or b.seq_hi <= s._synced_seq:
                     continue
                 s._apply_records(b.recs)

@@ -266,6 +266,13 @@ class StreamingScorer:
         self._serve_done_gen = 0
         self._serve_ticking = False
         self._serve_result: dict | None = None
+        # graft-evolve: the params generation this scorer serves (0 = the
+        # offline checkpoint; the rules fold has no learned params so the
+        # base scorer never advances it). GnnStreamingScorer's hot
+        # checkpoint swap bumps it at a queue generation boundary; every
+        # TickSpan and verdict dict carries the generation that actually
+        # produced it, so a swap is auditable tick by tick.
+        self.params_generation = 0
         # graft-shield seam: when a FaultInjector (rca/faults.py) is
         # attached, the tick pipeline consults it at each named stage —
         # None (the default) costs one attribute read per hook. The
@@ -1331,6 +1338,7 @@ class StreamingScorer:
         if span is not None:
             span.pending = len(self._pending_feat) + len(self._dirty_rows)
             span.coalesced = self._scope_coalesced_since
+            span.params_gen = self.params_generation
             self._scope_coalesced_since = 0
         sharded = self._graph_sharded(self.snapshot.padded_nodes,
                                       self.snapshot.padded_incidents)
@@ -1818,5 +1826,6 @@ class StreamingScorer:
             "dispatch_seconds": dispatch_s,
             "fetch_seconds": fetch_s,
             "device_seconds": queue_wait_s + dispatch_s + fetch_s,
+            "params_generation": self.params_generation,
             **stats,
         }

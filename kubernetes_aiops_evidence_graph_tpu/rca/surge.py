@@ -715,6 +715,52 @@ class MultiTenantScorer(StreamingScorer):
         return out
 
 
+def swap_tenants_atomically(targets, params, source: str = "") -> int:
+    """graft-evolve: flip EVERY tenant's resident GNN scorer to one new
+    params generation atomically. The rules pack (MultiTenantScorer)
+    carries no learned params — multi-tenant GNN serving keeps per-tenant
+    resident scorers riding the same async protocol (ROADMAP item 2), so
+    "tenants swap atomically together" means: acquire every tenant
+    scorer's ``serve_lock`` FIRST (in the caller's stable registration
+    order — every swapper must use this helper, which is what makes the
+    ordered acquisition deadlock-free), then install the same generation
+    through each scorer's locked seam. No tick on any tenant can observe
+    a mix: each tenant's in-flight ticks complete on the old generation,
+    and every dispatch that starts after this returns serves the new one.
+    Shield-wrapped targets WAL-journal the swap (exact leaves) before it
+    applies, per the crash-consistency invariant. Returns the shared new
+    generation (1 + the max across tenants, so replay ordering stays
+    monotonic for every journal)."""
+    import jax
+    from contextlib import ExitStack
+    targets = list(targets)
+    if not targets:
+        raise ValueError("swap_tenants_atomically needs >= 1 scorer")
+    with ExitStack() as stack:
+        for t in targets:
+            stack.enter_context(t.serve_lock)
+        gen = 1 + max(int(getattr(t, "params_generation", 0))
+                      for t in targets)
+        leaves = None
+        for t in targets:
+            journal = getattr(t, "journal", None)   # ShieldedScorer seam
+            scorer = getattr(t, "scorer", t)
+            if journal is not None:
+                if leaves is None:
+                    leaves = [np.asarray(x)
+                              for x in jax.tree_util.tree_leaves(params)]
+                seq = int(scorer._synced_seq)
+                journal.append((), seq, seq, kind="params_swap",
+                               force_sync=True, generation=gen,
+                               leaves=leaves, source=source)
+            scorer._swap_params_locked(params, gen, source=source)
+    obs_metrics.LEARN_SWAPS.inc()
+    obs_scope.FLIGHT_RECORDER.note_event(
+        "params_swap_atomic", generation=gen, tenants=len(targets),
+        source=source)
+    return gen
+
+
 class SurgeServer:
     """Process-wide multi-tenant serving front-end.
 

@@ -203,7 +203,12 @@ def _streaming_hypotheses(ctx: IncidentContext,
         i = raw["incident_ids"].index(sid)
     except ValueError:
         return None
-    if backend_name == "gnn":
+    # key off the RESULT surface, not the configured backend: a
+    # checkpoint-unusable worker serves rca_backend=gnn from the rules
+    # tier (worker._build_gnn_scorer), whose raw dict carries
+    # matched/scores instead of probs — slicing must follow the verdict
+    # that was actually produced
+    if backend_name == "gnn" and "probs" in raw:
         one = {"incident_ids": [nid], "probs": raw["probs"][i:i + 1]}
         return get_backend("gnn").results(None, raw=one)[0].hypotheses
     one = {  # slice this incident's row; results() is row-wise

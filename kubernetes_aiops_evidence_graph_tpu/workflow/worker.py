@@ -107,10 +107,7 @@ class IncidentWorker:
         with self._scorer_lock:
             if self.scorer is None:
                 if self.settings.rca_backend == "gnn":
-                    from ..rca.gnn_streaming import GnnStreamingScorer
-                    scorer = GnnStreamingScorer(
-                        self.builder.store, self.settings,
-                        mesh=self._serving_mesh())
+                    scorer = self._build_gnn_scorer()
                 else:
                     from ..rca.streaming import StreamingScorer
                     scorer = StreamingScorer(self.builder.store,
@@ -138,6 +135,29 @@ class IncidentWorker:
                     scorer.recover_or_snapshot()
                 self.scorer = scorer
             return self.scorer
+
+    def _build_gnn_scorer(self):
+        """GnnStreamingScorer, or the RULES serving tier when the
+        checkpoint is unusable (corrupt, legacy pre-relation-aware, or
+        missing): graft-evolve hot swap multiplies how often checkpoints
+        load, and a bad one must degrade serving — verdicts keep flowing
+        from the rules fold — never crash the worker. The fallback is
+        loud (error log + shield tier counter) and the workflow's
+        hypothesis slicing keys off the RESULT surface, so a rules-tier
+        scorer under rca_backend=gnn serves rules hypotheses."""
+        from ..observability import metrics as obs_metrics
+        from ..rca.gnn_backend import CheckpointError
+        from ..rca.gnn_streaming import GnnStreamingScorer
+        try:
+            return GnnStreamingScorer(self.builder.store, self.settings,
+                                      mesh=self._serving_mesh())
+        except CheckpointError as exc:
+            log.error("gnn_checkpoint_unusable_rules_fallback",
+                      error=str(exc))
+            obs_metrics.SHIELD_TIER_TRANSITIONS.inc(tier="rules_fallback")
+            from ..rca.streaming import StreamingScorer
+            return StreamingScorer(self.builder.store, self.settings,
+                                   mesh=self._serving_mesh())
 
     def _serving_mesh(self):
         """settings.mesh_dp > 1 -> a dp mesh (incident tables shard);

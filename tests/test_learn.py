@@ -1,0 +1,620 @@
+"""graft-evolve: online learning loop (learn/) — acceptance suite.
+
+Pins the PR's contracts:
+
+* **Swap atomicity (two oracles)**: under randomized churn at pipeline
+  depths {1, 2} and graph shards {1, 2}, every verdict is bit-identical
+  to one of exactly two oracles — a scorer serving the OLD params for
+  the whole script, or one serving the NEW params for the whole script —
+  with the generation boundary at the swap tick. No torn/mixed-params
+  verdicts: a verdict reporting generation g must bit-match generation
+  g's oracle.
+* **In-flight ticks complete on old params**: a deferred newest-tick
+  fetch right after a swap serves the OLD generation's bits (and says
+  so); the next fresh dispatch serves the new one without a retrace.
+* **Crash recovery mid-swap**: the shield WAL's ``params_swap`` record
+  restores the exact swapped generation, and replay reaches steady-state
+  bit-parity with the uncrashed scorer.
+* **Gate honesty**: a deliberately poisoned (label-noise) fine-tune is
+  rejected by the eval gate and never swapped, counted in
+  ``aiops_learn_gate_rejects_total``.
+* **Rollback**: non-finite verdicts right after a swap roll back to the
+  previous generation via the shield ladder's ``params_rollback`` rung.
+* Label harvesting precedence, episode masking, replay-buffer dedup, the
+  feedback/learning API surface, and the corrupt-checkpoint → rules-tier
+  fallback (the error path hot swap multiplies).
+"""
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.learn import (
+    OnlineLearner, ReplayBuffer, build_episode, harvest_labels)
+from kubernetes_aiops_evidence_graph_tpu.models import (
+    Hypothesis, HypothesisCategory, HypothesisFeedback, HypothesisSource,
+    RemediationAction, VerificationResult)
+from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
+    LEARN_GATE_REJECTS, LEARN_ROLLBACKS)
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+    CheckpointError, GnnRcaBackend, _shipped_checkpoint,
+    load_validated_checkpoint)
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+    GnnStreamingScorer)
+from kubernetes_aiops_evidence_graph_tpu.rca.ruleset import RULE_INDEX
+from kubernetes_aiops_evidence_graph_tpu.simulator import SCENARIOS
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, store_step, stream_step)
+from kubernetes_aiops_evidence_graph_tpu.storage import Database
+
+from tests.test_streaming import _world
+
+
+@pytest.fixture(scope="module")
+def params():
+    path = _shipped_checkpoint()
+    if path is None:
+        pytest.skip("shipped GNN checkpoint not present")
+    return load_validated_checkpoint(path)
+
+
+@pytest.fixture(scope="module")
+def params_b(params):
+    """A second, numerically distinct params tree of the same shapes —
+    the 'new checkpoint' of the two-oracle contract."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) * 1.03 + 0.01, params)
+
+
+def _cfg(depth=2, shards=1):
+    return load_settings(
+        serve_pipeline_depth=depth, serve_graph_shards=shards,
+        node_bucket_sizes=(256, 512, 1024, 2048),
+        edge_bucket_sizes=(1024, 4096, 16384),
+        incident_bucket_sizes=(8, 32))
+
+
+def _run_swap_script(depth, shards, p_start, p_swap=None, swap_at=60,
+                     events=120, seed=11, checkpoint_every=40):
+    """Deterministic churn script with an optional mid-script hot swap;
+    rescore() at fixed checkpoints. Tick readiness is FROZEN (the
+    backpressure tests' trick): whether the device finished tick t
+    before event t+1 is wall-clock noise that changes dispatch batching
+    — and with it the GNN mirror's slot-reuse order — between otherwise
+    identical runs, which is exactly the run-to-run float jitter the
+    bit-exact two-oracle contract must control for. With readiness
+    frozen the pipeline fills to depth, submissions coalesce, and every
+    dispatch point is a deterministic function of the script alone."""
+    cfg = _cfg(depth, shards)
+    cluster, builder, incidents = _world(seed=seed, settings=cfg)
+    scorer = GnnStreamingScorer(builder.store, cfg, params=p_start,
+                                now_s=cluster.now.timestamp())
+    scorer._tick_ready = lambda handles: False
+    scorer.rescore()
+    stream = list(churn_events(
+        cluster, events, seed=seed + 1,
+        incident_ids=tuple(f"incident:{i.id}" for i in incidents)))
+    outs = []
+    for i, ev in enumerate(stream):
+        stream_step(cluster, builder.store, scorer, ev)
+        scorer.tick_async()
+        if p_swap is not None and i + 1 == swap_at:
+            scorer.swap_params(p_swap)
+        if (i + 1) % checkpoint_every == 0:
+            outs.append(scorer.rescore())
+    outs.append(scorer.rescore())
+    return outs
+
+
+@pytest.mark.parametrize("depth", (1, 2))
+@pytest.mark.parametrize("shards", (1, 2))
+def test_swap_parity_two_oracles(depth, shards, params, params_b):
+    """Acceptance: every checkpointed verdict bit-matches exactly the
+    oracle of the generation it REPORTS — old params before the swap
+    tick, new params at/after it. No mixed-params verdicts exist."""
+    live = _run_swap_script(depth, shards, params, p_swap=params_b)
+    old = _run_swap_script(depth, shards, params)
+    new = _run_swap_script(depth, shards, params_b)
+    assert len(live) == len(old) == len(new)
+    gens = [o["params_generation"] for o in live]
+    assert gens[0] == 0 and gens[-1] == 1, gens
+    assert gens == sorted(gens), f"generation regressed mid-script: {gens}"
+    for k, out in enumerate(live):
+        oracle = old[k] if out["params_generation"] == 0 else new[k]
+        assert len(out["incident_ids"]) == len(oracle["incident_ids"])
+        np.testing.assert_array_equal(
+            np.asarray(out["probs"]), np.asarray(oracle["probs"]),
+            err_msg=f"verdict {k} (gen {out['params_generation']}) is not "
+                    f"bit-identical to its oracle at depth={depth} "
+                    f"shards={shards}")
+        np.testing.assert_array_equal(out["top_rule_index"],
+                                      oracle["top_rule_index"])
+
+
+def test_inflight_ticks_complete_on_old_params(params, params_b):
+    """The swap lands at a queue generation boundary: ticks already in
+    flight fetch as the OLD generation (bit-equal to old params), the
+    next dispatch serves the new one — and the jit cache is not
+    retraced (same shapes)."""
+    cfg = _cfg(depth=2)
+    cluster, builder, incidents = _world(seed=3, settings=cfg)
+    scorer = GnnStreamingScorer(builder.store, cfg, params=params,
+                                now_s=cluster.now.timestamp())
+    before = scorer.rescore()
+    # queue one tick on the old params (no new deltas afterwards)
+    scorer.tick_async()
+    scorer.swap_params(params_b)
+    with scorer.serve_lock:
+        deferred = scorer.rescore_newest()
+    assert deferred["newest_fetch"] is True
+    assert deferred["params_generation"] == 0
+    np.testing.assert_array_equal(np.asarray(deferred["probs"]),
+                                  np.asarray(before["probs"]))
+    after = scorer.rescore()
+    assert after["params_generation"] == 1
+    assert not np.array_equal(np.asarray(after["probs"]),
+                              np.asarray(before["probs"])), \
+        "new generation must actually change the verdict surface"
+
+
+@pytest.mark.fault_injection
+def test_shield_recovery_mid_swap_restores_generation(tmp_path, params,
+                                                      params_b):
+    """Crash after a journaled swap: recovery restores the swapped
+    generation (exact leaves from the WAL record) and replays to
+    steady-state bit-parity with the uncrashed scorer."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.shield import ShieldedScorer
+    cfg = load_settings(shield_enabled=True,
+                        shield_snapshot_every_ticks=10 ** 6)
+    cluster, builder, incidents = _world(seed=5, num_pods=100, settings=cfg)
+    now = cluster.now.timestamp()
+    scorer = GnnStreamingScorer(builder.store, cfg, params=params,
+                                now_s=now)
+    shield = ShieldedScorer(scorer, cfg, directory=str(tmp_path))
+    shield.recover_or_snapshot()
+    events = list(churn_events(
+        cluster, 60, seed=7,
+        incident_ids=tuple(builder.store.incident_ids())))
+    for ev in events[:30]:
+        store_step(cluster, builder.store, ev)
+    shield.rescore()
+    gen = shield.swap_params(params_b, source="ckpt-gen1")
+    assert gen == 1
+    for ev in events[30:]:
+        store_step(cluster, builder.store, ev)
+    shield.rescore()
+    live = shield.rescore()
+    assert live["params_generation"] == 1
+
+    # crash: a fresh process would reload the OLD checkpoint — recovery
+    # must land on the swapped generation regardless
+    scorer2 = GnnStreamingScorer(builder.store, cfg, params=params,
+                                 now_s=now)
+    shield2 = ShieldedScorer(scorer2, cfg, directory=str(tmp_path))
+    rec = shield2.recover()
+    assert rec["mode"] == "journal_replay"
+    assert scorer2.params_generation == 1
+    assert scorer2._params_source == "ckpt-gen1"
+    for a, b in zip(jax.tree_util.tree_leaves(scorer._params),
+                    jax.tree_util.tree_leaves(scorer2._params)):
+        np.testing.assert_array_equal(np.asarray(jax.device_get(a)),
+                                      np.asarray(jax.device_get(b)))
+    shield2.rescore()               # drains the replayed pending deltas
+    out2 = shield2.rescore()        # steady state
+    np.testing.assert_array_equal(np.asarray(live["probs"]),
+                                  np.asarray(out2["probs"]))
+    assert out2["params_generation"] == 1
+
+
+def test_rollback_on_post_swap_nonfinite(tmp_path, params):
+    """A poisoned swap (gate bypassed) producing non-finite verdicts is
+    rolled back by the shield ladder's params_rollback rung: serving
+    returns finite verdicts bit-equal to the pre-swap generation and the
+    rollback is counted."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.shield import ShieldedScorer
+    cfg = load_settings(shield_enabled=True,
+                        shield_snapshot_every_ticks=10 ** 6)
+    cluster, builder, incidents = _world(seed=9, num_pods=100, settings=cfg)
+    scorer = GnnStreamingScorer(builder.store, cfg, params=params,
+                                now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(scorer, cfg, directory=str(tmp_path))
+    shield.recover_or_snapshot()
+    before = shield.rescore()
+    rb0 = LEARN_ROLLBACKS.value()
+    poison = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) + np.float32("nan"), params)
+    shield.swap_params(poison, source="poisoned")
+    out = shield.rescore()   # ladder heals inline: finite, rolled back
+    assert np.isfinite(np.asarray(out["probs"])).all()
+    np.testing.assert_array_equal(np.asarray(out["probs"]),
+                                  np.asarray(before["probs"]))
+    assert "params_rollback" in shield.tier_log
+    assert LEARN_ROLLBACKS.value() == rb0 + 1
+    # generations stay monotonic: swap=1, rollback mints 2
+    assert scorer.params_generation == 2
+
+
+def test_atomic_multi_tenant_swap(params, params_b):
+    """rca/surge.swap_tenants_atomically: every tenant scorer flips to
+    ONE shared generation; verdicts on both tenants bit-match their
+    single-tenant new-params oracles."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.surge import (
+        swap_tenants_atomically)
+    cfg = _cfg(depth=1)
+    worlds = [_world(seed=s, num_pods=100, settings=cfg) for s in (21, 22)]
+    scorers = [GnnStreamingScorer(b.store, cfg, params=params,
+                                  now_s=c.now.timestamp())
+               for c, b, _ in worlds]
+    for s in scorers:
+        s.rescore()
+    gen = swap_tenants_atomically(scorers, params_b, source="shared")
+    assert gen == 1
+    assert all(s.params_generation == 1 for s in scorers)
+    for (c, b, _), s in zip(worlds, scorers):
+        oracle = GnnStreamingScorer(b.store, cfg, params=params_b,
+                                    now_s=c.now.timestamp()).rescore()
+        mine = s.rescore()
+        np.testing.assert_array_equal(np.asarray(mine["probs"]),
+                                      np.asarray(oracle["probs"]))
+
+
+# -- episode builder + harvest ----------------------------------------------
+
+def _seed_db_labels(db, incidents, rules, confidence=0.95,
+                    feedback_for=(), verified_for=(), wrong_truth=None):
+    """Insert rules-tier hypotheses (weak labels) for every incident,
+    plus optional operator feedback / verification rows."""
+    hyps = {}
+    for inc, rule in zip(incidents, rules):
+        db.create_incident(inc)
+        h = Hypothesis(
+            incident_id=inc.id,
+            category=HypothesisCategory.RESOURCE_EXHAUSTION,
+            title=rule, confidence=confidence, rank=1, rule_id=rule,
+            backend="tpu", generated_by=HypothesisSource.RULES_ENGINE)
+        db.insert_hypotheses([h])
+        hyps[str(inc.id)] = h
+    for inc in feedback_for:
+        h = hyps[str(inc.id)]
+        truth = (wrong_truth or {}).get(str(inc.id))
+        db.insert_feedback(HypothesisFeedback(
+            hypothesis_id=h.id, was_correct=truth is None,
+            actual_root_cause=truth, submitted_by="operator"))
+    for inc in verified_for:
+        h = hyps[str(inc.id)]
+        action = RemediationAction(
+            incident_id=inc.id, hypothesis_id=h.id,
+            idempotency_key=f"test-{inc.id}", action_type="restart_pod",
+            target_resource="dep")
+        db.upsert_action(action)
+        db.insert_verification(VerificationResult(
+            action_id=action.id, incident_id=inc.id, success=True,
+            metrics_improved=True))
+    return hyps
+
+
+def test_harvest_precedence_episode_masking_and_dedup():
+    """feedback > verification > weak rule labels; only labeled incident
+    rows are unmasked; the replay buffer dedups by fingerprint."""
+    cfg = _cfg(depth=1)
+    scenarios = ("crashloop_deploy", "oom", "network")
+    cluster, builder, incidents = _world(seed=31, settings=cfg,
+                                         scenarios=scenarios)
+    db = Database(":memory:")
+    rules = [SCENARIOS[s].expected_rule for s in scenarios]
+    # incident 0: weak only; incident 1: verification confirms; incident
+    # 2: operator says the rule was WRONG and names another root cause
+    other_rule = next(r for r in RULE_INDEX if r != rules[2])
+    _seed_db_labels(
+        db, incidents, rules,
+        feedback_for=[incidents[2]], verified_for=[incidents[1]],
+        wrong_truth={str(incidents[2].id): other_rule})
+    labels = harvest_labels(db)
+    assert labels[str(incidents[0].id)] == (RULE_INDEX[rules[0]],
+                                            "weak_rule")
+    assert labels[str(incidents[1].id)] == (RULE_INDEX[rules[1]],
+                                            "verification")
+    assert labels[str(incidents[2].id)] == (RULE_INDEX[other_rule],
+                                            "feedback")
+
+    ep = build_episode(builder.store, labels, cfg,
+                       now_s=cluster.now.timestamp())
+    assert ep is not None
+    assert int(np.asarray(ep["label_mask"]).sum()) == 3
+    mask = np.asarray(ep["label_mask"]) > 0
+    labeled = set(np.asarray(ep["labels"])[mask].tolist())
+    assert labeled == {RULE_INDEX[rules[0]], RULE_INDEX[rules[1]],
+                       RULE_INDEX[other_rule]}
+
+    buf = ReplayBuffer(cap=4)
+    assert buf.add(ep) is True
+    assert buf.add(build_episode(builder.store, labels, cfg,
+                                 now_s=cluster.now.timestamp())) is False
+    assert len(buf) == 1 and buf.duplicates == 1
+    # a label change produces a NEW episode fingerprint
+    labels2 = dict(labels)
+    labels2[str(incidents[0].id)] = (RULE_INDEX[rules[1]], "feedback")
+    assert buf.add(build_episode(builder.store, labels2, cfg,
+                                 now_s=cluster.now.timestamp())) is True
+
+
+def test_sharded_finetune_drives_data_mesh(params):
+    """learn_mesh_shards > 1: the fine-tune drives the EXISTING sharded
+    train step on a (1 × D) data mesh — episodes partition through
+    parallel/partition.py with the label mask substituted for the
+    incident mask, and the result stays finite."""
+    from kubernetes_aiops_evidence_graph_tpu.learn.trainer import (
+        finetune, params_finite)
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import make_dataset
+    eps = make_dataset(2, 96, 4, seed=7, return_snapshot=True)
+    out = finetune(params, eps[:1], eps[1:], steps=6, lr=1e-3,
+                   anchor_weight=1e-3, mesh_shards=2)
+    assert out["sharded"] is True
+    assert out["steps"] == 6
+    assert params_finite(out["params"])
+    # the candidate really trained (params moved off the serving tree)
+    moved = any(
+        not np.array_equal(np.asarray(jax.device_get(a)),
+                           np.asarray(jax.device_get(b)))
+        for a, b in zip(jax.tree_util.tree_leaves(out["params"]),
+                        jax.tree_util.tree_leaves(params)))
+    assert moved
+
+
+def test_closed_incidents_replay_from_persisted_evidence():
+    """The common production flow: feedback/verification lands AFTER the
+    workflow closed the incident — the incident is gone from the live
+    evidence graph but its evidence rows persist. Harvest must rebuild
+    the window from the durable store (build_replay_episode) and label
+    it, so closure never starves the loop."""
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.learn.episodes import (
+        build_replay_episode)
+    cfg = _cfg(depth=1)
+    scenarios = ("crashloop_deploy", "oom")
+    cluster, builder, incidents = _world(seed=81, settings=cfg,
+                                         scenarios=scenarios)
+    db = Database(":memory:")
+    rules = [SCENARIOS[s].expected_rule for s in scenarios]
+    _seed_db_labels(db, incidents, rules, feedback_for=incidents)
+    # persist the evidence rows (what collect_evidence does), then CLOSE:
+    # the incidents leave the live graph entirely
+    for inc in incidents:
+        results = collect_all(inc, default_collectors(cluster, cfg),
+                              parallel=False)
+        db.insert_evidence([e for r in results for e in r.evidence])
+        builder.store.remove_node(f"incident:{inc.id}")
+    assert all(builder.store.get_node(f"incident:{i.id}") is None
+               for i in incidents)
+    labels = harvest_labels(db)
+    assert build_episode(builder.store, labels, cfg) is None, \
+        "premise: the live window has nothing left to label"
+    ep = build_replay_episode(db, labels, cfg)
+    assert ep is not None
+    assert int(np.asarray(ep["label_mask"]).sum()) == len(incidents)
+    mask = np.asarray(ep["label_mask"]) > 0
+    assert set(np.asarray(ep["labels"])[mask].tolist()) == {
+        RULE_INDEX[r] for r in rules}
+    # and the loop-level harvest routes closed incidents there
+    scorer = GnnStreamingScorer(builder.store, cfg,
+                                params=gnn.init_params(
+                                    jax.random.PRNGKey(0)),
+                                now_s=cluster.now.timestamp())
+    learner = OnlineLearner(db, [scorer], settings=_learn_settings(),
+                            now_s=cluster.now.timestamp())
+    assert learner.harvest() == 1
+    assert len(learner.buffer) == 1
+
+
+def _learn_settings(**over):
+    base = dict(
+        node_bucket_sizes=(256, 512, 1024, 2048),
+        edge_bucket_sizes=(1024, 4096, 16384),
+        incident_bucket_sizes=(8, 32),
+        learn_enabled=True, learn_steps=60, learn_lr=2e-3,
+        learn_min_episodes=1, learn_holdout_every=0,
+        learn_sim_episodes=2, learn_sim_holdout=1,
+        learn_sim_incidents=4, rca_backend="gnn")
+    base.update(over)
+    return load_settings(**base)
+
+
+def test_loop_learns_from_production_verdicts_and_swaps(params):
+    """The aha: a weak serving checkpoint (fresh random params) fine-tunes
+    on harvested production labels + the simulator mix, passes the gate
+    (candidate strictly better than serving), and hot-swaps — generation
+    advances and the loop's status surface reflects all of it."""
+    cfg = _learn_settings()
+    scenarios = ("crashloop_deploy", "oom", "network")
+    cluster, builder, incidents = _world(seed=41, settings=cfg,
+                                         scenarios=scenarios)
+    db = Database(":memory:")
+    rules = [SCENARIOS[s].expected_rule for s in scenarios]
+    _seed_db_labels(db, incidents, rules, feedback_for=incidents)
+    weak = gnn.init_params(jax.random.PRNGKey(123))
+    scorer = GnnStreamingScorer(builder.store, cfg, params=weak,
+                                now_s=cluster.now.timestamp())
+    learner = OnlineLearner(db, [scorer], settings=cfg,
+                            now_s=cluster.now.timestamp())
+    out = learner.run_once()
+    assert out["harvested"] == 1 and out["trained"] is True
+    assert out["swapped"] is True and out["generation"] == 1
+    assert scorer.params_generation == 1
+    ev = out["gate"]
+    assert ev["finite"] and ev["candidate_top1"] >= ev["serving_top1"]
+    assert ev["candidate_top1"] > 0.5, \
+        f"fine-tune barely learned: {ev}"
+    st = learner.status()
+    assert st["swaps"] == 1 and st["generation"] == 1
+    assert st["buffer_size"] == 1
+    # second cycle: steady store = duplicate episode, nothing retrains
+    # a worse candidate past the gate silently
+    out2 = learner.run_once()
+    assert out2["harvested"] == 0
+
+
+def test_gate_rejects_poisoned_finetune(params):
+    """Gate honesty: label-noise fine-tune (every production label
+    shifted off its true class) must be discarded — counted, never
+    swapped; the serving generation stays put."""
+    cfg = _learn_settings(learn_steps=80, learn_lr=2e-2,
+                          learn_anchor_weight=0.0,
+                          learn_sim_episodes=0,
+                          learn_weak_labels=True)
+    scenarios = ("crashloop_deploy", "oom", "network")
+    cluster, builder, incidents = _world(seed=51, settings=cfg,
+                                         scenarios=scenarios)
+    db = Database(":memory:")
+    # poison: every weak label is a WRONG rule for its incident
+    wrong = [[r for r in sorted(RULE_INDEX)
+              if r != SCENARIOS[s].expected_rule][i % (len(RULE_INDEX) - 1)]
+             for i, s in enumerate(scenarios)]
+    _seed_db_labels(db, incidents, wrong)
+    scorer = GnnStreamingScorer(builder.store, cfg, params=params,
+                                now_s=cluster.now.timestamp())
+    learner = OnlineLearner(db, [scorer], settings=cfg,
+                            now_s=cluster.now.timestamp())
+    r0 = LEARN_GATE_REJECTS.value()
+    out = learner.run_once()
+    assert out["trained"] is True
+    assert out["swapped"] is False
+    assert scorer.params_generation == 0
+    assert learner.gate_rejects == 1
+    assert LEARN_GATE_REJECTS.value() == r0 + 1
+    assert out["gate"]["candidate_top1"] < out["gate"]["serving_top1"]
+
+
+# -- API surface --------------------------------------------------------------
+
+def _post(base, path, payload):
+    import json
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(base, path):
+    import json
+    with urllib.request.urlopen(base + path) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_feedback_and_learning_api(tmp_path):
+    from kubernetes_aiops_evidence_graph_tpu.app import AiopsApp
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        generate_cluster)
+    settings = load_settings(db_path=str(tmp_path / "t.sqlite"),
+                             remediation_enabled=False)
+    cluster = generate_cluster(num_pods=40, seed=0)
+    app = AiopsApp(cluster, settings)
+    port = app.start(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        from uuid import uuid4
+        from kubernetes_aiops_evidence_graph_tpu.models import (
+            Incident, IncidentCreate)
+        from kubernetes_aiops_evidence_graph_tpu.ingestion.normalizer \
+            import AlertNormalizer
+        inc = Incident(**AlertNormalizer.normalize_alertmanager({
+            "labels": {"alertname": "t", "namespace": "default"},
+            "annotations": {}, "status": "firing"}).model_dump())
+        app.db.create_incident(inc)
+        h = Hypothesis(
+            incident_id=inc.id,
+            category=HypothesisCategory.RESOURCE_EXHAUSTION,
+            title="t", confidence=0.9, rank=1, rule_id="oom_killed",
+            generated_by=HypothesisSource.RULES_ENGINE)
+        app.db.insert_hypotheses([h])
+        # valid: flat body carrying the hypothesis id
+        status, body = _post(base, "/api/v1/feedback", {
+            "hypothesis_id": str(h.id), "was_correct": True,
+            "submitted_by": "op"})
+        assert status == 201 and body["recorded"] is True
+        assert app.db.feedback_for(h.id)
+        # orphan hypothesis id -> 404 via insert_feedback's False path
+        try:
+            _post(base, "/api/v1/feedback", {
+                "hypothesis_id": str(uuid4()), "was_correct": False})
+            assert False, "orphan feedback must 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # invalid body -> 400
+        try:
+            _post(base, "/api/v1/feedback", {"was_correct": True})
+            assert False, "missing hypothesis_id must 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # learning status: learner off by default
+        status, body = _get(base, "/api/v1/learning")
+        assert status == 200
+        assert body == {"enabled": False, "running": False}
+    finally:
+        app.stop()
+
+
+def test_learning_status_surface(params):
+    cfg = _learn_settings()
+    cluster, builder, _ = _world(seed=61, settings=cfg)
+    db = Database(":memory:")
+    scorer = GnnStreamingScorer(builder.store, cfg, params=params,
+                                now_s=cluster.now.timestamp())
+    learner = OnlineLearner(db, [scorer], settings=cfg)
+    st = learner.status()
+    assert st["generation"] == 0 and st["buffer_size"] == 0
+    assert st["tenants"] == 1 and st["running"] is False
+
+
+# -- checkpoint error path (satellite) ---------------------------------------
+
+def test_corrupt_checkpoint_raises_clear_error(tmp_path):
+    bad = tmp_path / "ckpt"
+    bad.mkdir()
+    (bad / "garbage").write_bytes(b"\x00\x01not-an-orbax-checkpoint")
+    with pytest.raises(CheckpointError, match="unreadable|params tree"):
+        load_validated_checkpoint(str(bad))
+    with pytest.raises(ValueError):   # CheckpointError IS a ValueError
+        GnnRcaBackend(settings=load_settings(gnn_checkpoint=str(bad)))
+
+
+def test_legacy_checkpoint_raises_clear_error(tmp_path, params):
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import (
+        save_checkpoint)
+    legacy = {k: v for k, v in params.items() if k != "layers"}
+    legacy["layers"] = [
+        {"w_self": np.asarray(l["w_self"]), "w_msg": np.asarray(l["b"]),
+         "b": np.asarray(l["b"])} for l in params["layers"]]
+    path = tmp_path / "legacy"
+    save_checkpoint(str(path), legacy, {"hidden": 64, "layers": 3})
+    with pytest.raises(CheckpointError, match="w_rel"):
+        load_validated_checkpoint(str(path))
+
+
+def test_worker_falls_back_to_rules_tier_on_bad_checkpoint(tmp_path):
+    """A gnn worker with an unusable checkpoint must keep serving from
+    the rules tier (degrade, never crash) — and the workflow slices the
+    rules result surface instead of KeyError-ing on probs."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        StreamingScorer)
+    from kubernetes_aiops_evidence_graph_tpu.workflow.worker import (
+        IncidentWorker)
+    bad = tmp_path / "ckpt"
+    bad.mkdir()
+    (bad / "garbage").write_bytes(b"junk")
+    cfg = load_settings(rca_backend="gnn", gnn_checkpoint=str(bad))
+    cluster, builder, _ = _world(seed=71, settings=cfg)
+    worker = IncidentWorker(cluster, Database(":memory:"),
+                            builder=builder, settings=cfg)
+    scorer = worker.serving_scorer()
+    assert isinstance(scorer, StreamingScorer)
+    assert not isinstance(scorer, GnnStreamingScorer)
+    out = scorer.rescore()
+    assert "probs" not in out and "scores" in out
+    worker.stop_warm()
