@@ -901,6 +901,224 @@ def bench_webhook_verdict_slo(num_pods: int = 2000, tenants: int = 4,
     }
 
 
+def bench_webhook_ingest(num_pods: int = 200, tenants: int = 4,
+                         events: int = 24000, batch: int = 256,
+                         target_eps: int = 10000, churn_per_batch: int = 12,
+                         ab_batches: int = 12, seed: int = 0,
+                         verbose: bool = True) -> dict:
+    """graft-intake: the webhook-bytes→staged-delta ingest record
+    (ROADMAP item 2) at 10× the paced SLO load.
+
+    Four tenant stores packed on ONE resident MultiTenantScorer serve a
+    paced alert storm at ``target_eps`` aggregate events/s. Every batch
+    runs the FULL columnar ingest pipeline from raw webhook BYTES:
+    ``json.loads`` (parse) → ``normalize_alertmanager_batch`` (columnar
+    transpose + array-op derivations) → hashed-ring batch dedup →
+    per-tenant store churn → ``scorer.absorb()`` (journal drain +
+    pipelined tick submission, the staged columnar slab path). The storm
+    is duplicate-heavy (a bounded fingerprint universe, the realistic
+    alert-storm shape), so the dedup window absorbs most rows before
+    anything touches pydantic.
+
+    Reported: sustained events/s vs target, p50/p99 absorb latency,
+    per-stage batch walls, dedup hit ratio, and a columnar-vs-dict
+    normalize A/B over identical batches (the dict AlertNormalizer loop
+    is the oracle the contract tests pin parity against)."""
+    import json as _json
+
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.columnar import (
+        normalize_alertmanager_batch)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.dedup import (
+        AlertDeduplicator)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.normalizer import (
+        AlertNormalizer)
+    from kubernetes_aiops_evidence_graph_tpu.rca.surge import (
+        MultiTenantScorer)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        SCENARIOS, generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, store_step)
+    import jax
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose \
+        else (lambda *a: None)
+    cfg = load_settings(scope_telemetry=False, ingest_columnar=True)
+    rng = np.random.default_rng(seed)
+
+    # -- tenant worlds: store + injected incidents + churn stream ---------
+    worlds = []
+    names = sorted(SCENARIOS)
+    n_batches = (events + batch - 1) // batch
+    for t in range(tenants):
+        cluster = generate_cluster(num_pods=num_pods, seed=seed + 31 + t)
+        wrng = np.random.default_rng(seed + 31 + t)
+        builder = GraphBuilder()
+        sync_topology(cluster, builder.store)
+        keys = sorted(cluster.deployments)
+        injected = []
+        for i in range(6):
+            inc = inject(cluster, names[(t + i) % len(names)],
+                         keys[(i * 5) % len(keys)], wrng)
+            injected.append(inc)
+            builder.ingest(inc, collect_all(
+                inc, default_collectors(cluster, cfg), parallel=False))
+        churn = list(churn_events(
+            cluster, n_batches * churn_per_batch, seed=seed + 131 + t,
+            incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+        worlds.append((f"tenant-{t}", cluster, builder, churn))
+
+    now_s = max(c.now.timestamp() for _n, c, _b, _s in worlds)
+    pack = MultiTenantScorer(
+        {name: b.store for name, _c, b, _s in worlds}, cfg, now_s=now_s)
+    pack.rescore()          # warm compile + first fetch
+    pack.warm(delta_sizes=(64, 256), row_sizes=(4, 16, 64))
+    pack.warm_growth()      # same treatment the production worker gets
+    dedup = AlertDeduplicator(cfg)
+
+    # -- the alert storm: bounded fingerprint universe, pre-serialized ----
+    # webhook BYTES per batch (the record starts at the wire, not at a
+    # parsed dict) — ~32 (alertname, service) pairs per tenant, drawn
+    # with repetition, so steady state is overwhelmingly duplicates: the
+    # shape a real storm has and the shape the dedup window must absorb
+    universe = []
+    alertnames = ("PodCrashLooping", "HighErrorRate", "HighLatency",
+                  "OOMKilled", "NodeNotReady", "HighCPU", "DiskPressure",
+                  "ImagePullBackOff")
+    for name, cluster, _b, _s in worlds:
+        keys = sorted(cluster.deployments)
+        for i in range(32):
+            ns, _, svc = keys[(i * 3) % len(keys)].partition("/")
+            universe.append({
+                "status": "firing",
+                "labels": {"alertname": alertnames[i % len(alertnames)],
+                           "namespace": f"{name}-{ns}", "service": svc,
+                           "severity": ("critical", "warning", "info")[i % 3],
+                           "cluster": name},
+                "annotations": {"description": f"storm alert {i}"},
+                "startsAt": "2026-08-05T08:00:00Z",
+            })
+    draws = rng.integers(0, len(universe), events)
+    batches_bytes = []
+    for b0 in range(0, events, batch):
+        alerts = [universe[j] for j in draws[b0:b0 + batch]]
+        batches_bytes.append(_json.dumps({"alerts": alerts}).encode())
+
+    # -- the paced run -----------------------------------------------------
+    batch_wall = batch / float(target_eps)
+    absorb_s: list[float] = []
+    batch_s: list[float] = []
+    stage_s = {"parse": 0.0, "normalize": 0.0, "dedup": 0.0, "churn": 0.0}
+    dup_rows = elig_rows = 0
+    churn_cursor = 0
+    t_start = time.perf_counter()
+    for bi, payload_bytes in enumerate(batches_bytes):
+        t_b = time.perf_counter()
+        t0 = time.perf_counter()
+        payload = _json.loads(payload_bytes)
+        t1 = time.perf_counter()
+        cols = normalize_alertmanager_batch(payload["alerts"])
+        t2 = time.perf_counter()
+        elig = np.flatnonzero(cols.eligible)
+        fps = cols.fingerprint[elig]
+        dup = dedup.check_batch(fps)
+        fresh = [str(f) for f in fps[~dup]]
+        if fresh:
+            dedup.register_batch(fresh)
+        t3 = time.perf_counter()
+        dup_rows += int(dup.sum())
+        elig_rows += len(elig)
+        # per-tenant store churn riding the same tick budget
+        for _name, cluster, builder, churn in worlds:
+            for ev in churn[churn_cursor:churn_cursor + churn_per_batch]:
+                store_step(cluster, builder.store, ev)
+        churn_cursor += churn_per_batch
+        t4 = time.perf_counter()
+        pack.absorb()
+        t5 = time.perf_counter()
+        stage_s["parse"] += t1 - t0
+        stage_s["normalize"] += t2 - t1
+        stage_s["dedup"] += t3 - t2
+        stage_s["churn"] += t4 - t3
+        absorb_s.append(t5 - t4)
+        if (bi + 1) % 8 == 0:
+            pack.serve(newest=True)   # verdict boundary off the ingest wall
+        batch_s.append(time.perf_counter() - t_b)
+        # deadline pacing: sleep to the CUMULATIVE schedule, so a single
+        # slow batch (a compile, a GC) borrows from the next batches'
+        # slack instead of permanently shifting the whole run — the
+        # sustained-rate claim is about keeping up, not per-batch jitter
+        deadline = t_start + (bi + 1) * batch_wall
+        spare = deadline - time.perf_counter()
+        if spare > 0:
+            time.sleep(spare)
+    wall = time.perf_counter() - t_start
+    pack.serve(newest=True)
+    pack.stop_warm()
+    achieved = events / wall
+    ingest_wall = sum(stage_s.values()) + sum(absorb_s)
+
+    # -- columnar vs dict normalize A/B over identical batches -----------
+    sample = batches_bytes[:ab_batches]
+    t0 = time.perf_counter()
+    for pb in sample:
+        alerts = _json.loads(pb)["alerts"]
+        for a in alerts:
+            if a.get("status") == "firing":
+                AlertNormalizer.normalize_alertmanager(a)
+    dict_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for pb in sample:
+        normalize_alertmanager_batch(_json.loads(pb)["alerts"])
+    col_wall = time.perf_counter() - t0
+
+    p50_absorb = float(np.percentile(absorb_s, 50)) * 1e3
+    p99_absorb = float(np.percentile(absorb_s, 99)) * 1e3
+    sustained = achieved >= target_eps * 0.95
+    log(f"webhook_ingest: {achieved:.0f} ev/s (target {target_eps}, "
+        f"sustained={sustained}) × {tenants} tenants; absorb p50 "
+        f"{p50_absorb:.2f} / p99 {p99_absorb:.2f} ms; dedup hit "
+        f"{dup_rows / max(elig_rows, 1):.3f}; normalize columnar "
+        f"{dict_wall / max(col_wall, 1e-9):.1f}x vs dict")
+    return {
+        "metric": "webhook_ingest",
+        "value": round(achieved, 1),
+        "unit": f"alerts/s sustained (target {target_eps}) × "
+                f"{tenants} tenants",
+        "vs_baseline": round(achieved / target_eps, 3),
+        "sustained": sustained,
+        "events": events,
+        "tenants": tenants,
+        "events_per_sec_target": target_eps,
+        "events_per_sec_achieved": round(achieved, 1),
+        "ingest_cpu_events_per_sec": round(
+            events / max(ingest_wall, 1e-9), 1),
+        "p50_absorb_ms": round(p50_absorb, 3),
+        "p99_absorb_ms": round(p99_absorb, 3),
+        "p50_batch_ms": round(float(np.percentile(batch_s, 50)) * 1e3, 3),
+        "p99_batch_ms": round(float(np.percentile(batch_s, 99)) * 1e3, 3),
+        "stage_ms_per_batch": {
+            k: round(v / max(len(batches_bytes), 1) * 1e3, 4)
+            for k, v in stage_s.items()},
+        "dedup_hit_ratio": round(dup_rows / max(elig_rows, 1), 4),
+        "unique_fingerprints": len(
+            {u["labels"]["alertname"] + u["labels"]["namespace"]
+             + u["labels"]["service"] for u in universe}),
+        "normalize_speedup_vs_dict": round(
+            dict_wall / max(col_wall, 1e-9), 2),
+        "tick_dispatches": int(pack.dispatches),
+        "coalesced_ticks": int(pack.coalesced_ticks),
+        "rebuilds": int(pack.rebuilds),
+        "columnar": bool(cfg.ingest_columnar),
+        "platform": jax.default_backend(),
+    }
+
+
 def _sharded_tick_census(scorer) -> dict:
     """Modeled per-tick collective census of the EXACT tick the sharded
     scorer dispatches at its live shapes: trace the tick's jaxpr and run
@@ -1601,6 +1819,15 @@ def run_config(cfg: int, args) -> dict:
                 "metric": "webhook_verdict_slo",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
+        # graft-intake ingest record: webhook bytes → staged delta at
+        # 10× the paced SLO load (10k ev/s × 4 tenants on one pack)
+        try:
+            print(json.dumps(bench_webhook_ingest()), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_ingest",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
         # pipelined-executor depth sweep (graft-pipeline): overlap
         # efficiency at depth 1/2/4 with depth parity asserted — emits on
         # CPU too, so the record is always present in the trajectory
@@ -1922,6 +2149,19 @@ def main(argv=None) -> int:
         except (Exception, SystemExit) as exc:
             print(json.dumps({
                 "metric": "webhook_verdict_slo",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # graft-intake smoke: the webhook-ingest record shape at small
+        # event counts (the 10k ev/s × 4-tenant claim runs in config 4;
+        # the smoke still paces to the full target rate — the batches
+        # are just fewer)
+        try:
+            print(json.dumps(bench_webhook_ingest(
+                num_pods=120, events=6000, batch=250, churn_per_batch=6,
+                verbose=False)), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_ingest",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
         # graft-evolve smoke: the online-learning record at laptop scale

@@ -115,6 +115,11 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
     ("rca/streaming.py", "_tick"): (
         ("padded_incidents", "pair_width", "pk", "rk", "width"),
         (0, 3, 4, 5)),
+    # graft-intake: the columnar staged-slab split (no donation — the
+    # slab is a host staging buffer, the outputs feed the tick's
+    # NON-donated ints/rows operands; registered jaxpr entrypoint
+    # ingest.delta_pack with zero-collective cost)
+    ("rca/streaming.py", "_delta_pack"): (("li", "pk", "dim"), ()),
     # graft-fleet mesh-resident ticks (parallel/sharded_streaming.py):
     # same donation contract as their single-device counterparts — the
     # sharded resident mirror flows through, never reallocates

@@ -295,6 +295,21 @@ def _rules_tick_multitenant_build():
     return fn, args
 
 
+def _delta_pack_build():
+    """graft-intake: the columnar staged-slab split — ONE int32 host→
+    device buffer per tick sliced into the fused tick's (ints, f_rows)
+    operands, the feature segment bitcast back to f32 (bit-exact). Zero
+    FLOPs, bytes ≈ 2× the slab; traced at the canonical streaming delta
+    shapes (pk=64, rk=4, width=128)."""
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.streaming import _delta_pack
+    pk, rk, width = 64, 4, 128
+    li = pk + 2 * rk + 2 * rk * width
+    fn = partial(_delta_pack, li=li, pk=pk, dim=DIM)
+    return fn, (np.zeros(li + pk * DIM, np.int32),)
+
+
 def _gnn_tick_build(pk: int = 64, ek: int = 256):
     np = _np()
     from ..graph.schema import DIM
@@ -622,6 +637,17 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "carry the sorted promise — expect_sorted_scatter stays off",
         cost=COST_DEFAULT),
     Entrypoint("streaming.rules_tick", _rules_tick_build, _TICK),
+    Entrypoint(
+        "ingest.delta_pack", _delta_pack_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="graft-intake columnar staging: one staged int32 slab per "
+              "tick sliced + bitcast into the tick's (ints, f_rows) on "
+              "device — a single host→device transfer where the dict "
+              "path paid two; zero FLOPs and zero collectives by "
+              "contract (the ingest path may never go distributed or "
+              "grow compute implicitly)",
+        cost=COST_DEFAULT),
     Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
     Entrypoint(
         "streaming.rules_tick.coalesced", _rules_tick_coalesced_build,

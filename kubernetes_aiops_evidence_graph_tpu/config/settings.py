@@ -39,6 +39,20 @@ class Settings:
     api_port: int = 8000
     webhook_rate_limit_per_minute: int = 100       # settings.py:119
     dedup_ttl_seconds: int = 4 * 3600              # deduplicator.py:20 (4h)
+    # graft-intake: vectorized columnar ingest — webhook batches parse
+    # into NumPy columns (ingestion/columnar.py), the dedup window becomes
+    # a hashed ring (ingestion/dedup.FingerprintRing) with batch probes,
+    # and the scorer's pending feature deltas stage into preallocated
+    # columnar buffers whose drain is a memcpy into ONE device-ready
+    # int32 slab per tick (rca/streaming.FeatureStage + _delta_pack).
+    # False restores the per-row dict path everywhere — the bit-parity
+    # oracle (same pattern as gnn_bucketed/gnn_pallas).
+    ingest_columnar: bool = True
+    # hashed dedup ring capacity (slots; rounded up to a power of two).
+    # Sized for ~4h of unique fingerprints at storm rates; overflowing a
+    # probe neighborhood evicts the oldest-expiry entry (counted in
+    # aiops_ingest_dedup_evictions_total).
+    ingest_dedup_window: int = 32768
 
     # --- storage ---
     db_path: str = "kaeg.sqlite"                   # replaces Postgres DSN

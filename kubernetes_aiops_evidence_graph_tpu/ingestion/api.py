@@ -120,6 +120,56 @@ class ApiHandler(BaseHTTPRequestHandler):
         self._text(200, REGISTRY.expose(), "text/plain; version=0.0.4")
 
     # -- webhooks (main.py:116-254) ---------------------------------------
+    #
+    # graft-intake: with settings.ingest_columnar the batch rides the
+    # vectorized columnar pipeline (ingestion/columnar.py — one payload
+    # transpose, array-op normalize, batch dedup probe, pydantic only for
+    # survivors; malformed rows masked + counted, never a 500). The
+    # per-row dict path below each handler is the behavioral oracle.
+
+    def _columnar_webhook(self, source: str, normalize, t_parse: float):
+        """Shared columnar handler tail: normalize → batch ingest →
+        per-stage aiops_ingest_* accounting. ``t_parse`` is the JSON
+        parse wall already spent in ``_body``."""
+        from ..observability.metrics import (
+            INGEST_BATCH_FILL, INGEST_MALFORMED_ROWS, INGEST_ROWS,
+            INGEST_ROWS_PER_SEC, INGEST_STAGE_SECONDS)
+        t1 = time.perf_counter()
+        cols = normalize()
+        t2 = time.perf_counter()
+        created, duplicates = self.app.ingest_batch(cols)
+        t3 = time.perf_counter()
+        n = len(cols)
+        ALERTS_RECEIVED.inc(float(n), source=source)
+        for iid, ns in created:
+            SCOPE.webhook_received(iid, tenant=ns or "default")
+        INGEST_STAGE_SECONDS.observe(t_parse, stage="parse", source=source)
+        INGEST_STAGE_SECONDS.observe(t2 - t1, stage="normalize",
+                                     source=source)
+        # dedup probe + spec construction + DB insert ride ingest_batch;
+        # the probe is a handful of vectorized compares, so the window
+        # is reported as one "persist" stage with dedup hits counted
+        # separately (aiops_ingest_dedup_hits_total)
+        INGEST_STAGE_SECONDS.observe(t3 - t2, stage="persist",
+                                     source=source)
+        if n:
+            eligible = int(cols.eligible.sum())
+            INGEST_ROWS.inc(float(len(created)), source=source,
+                            outcome="created")
+            INGEST_ROWS.inc(float(duplicates), source=source,
+                            outcome="duplicate")
+            INGEST_ROWS.inc(float(n - cols.malformed - eligible),
+                            source=source, outcome="not_firing")
+            if cols.malformed:
+                INGEST_ROWS.inc(float(cols.malformed), source=source,
+                                outcome="malformed")
+                INGEST_MALFORMED_ROWS.inc(float(cols.malformed),
+                                          source=source)
+            INGEST_BATCH_FILL.set(eligible / n, site="webhook")
+            wall = t_parse + (t3 - t1)
+            if wall > 0:
+                INGEST_ROWS_PER_SEC.set(n / wall, source=source)
+        return [iid for iid, _ns in created], duplicates
 
     @route("POST", "/api/v1/webhooks/alertmanager")
     def webhook_alertmanager(self):
@@ -130,15 +180,29 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._json(429, {"error": "rate limit exceeded"})
             return
         payload = self._body()
+        t_parse = time.perf_counter() - t0
         alerts = payload.get("alerts", []) or []
-        if not isinstance(alerts, list) or any(not isinstance(a, dict) for a in alerts):
+        if not isinstance(alerts, list):
             self._json(400, {"error": "alerts must be a list of alert objects"})
             return
-        created, duplicates = [], 0
         # graft-scope: the webhook span is the ROOT of the incident's
         # trace — ServeScope carries its context to the async workflow
         # (workflow/engine.py parents every step span under it) and
         # stamps the arrival time the webhook→verdict SLO measures from
+        if getattr(self.app.settings, "ingest_columnar", False):
+            from .columnar import normalize_alertmanager_batch
+            with TRACER.span("webhook.alertmanager", alerts=len(alerts)):
+                created, duplicates = self._columnar_webhook(
+                    "alertmanager",
+                    lambda: normalize_alertmanager_batch(alerts), t_parse)
+            WEBHOOK_LATENCY.observe(time.perf_counter() - t0,
+                                    endpoint="alertmanager")
+            self._json(200, {"created": created, "duplicates": duplicates})
+            return
+        if any(not isinstance(a, dict) for a in alerts):
+            self._json(400, {"error": "alerts must be a list of alert objects"})
+            return
+        created, duplicates = [], 0
         with TRACER.span("webhook.alertmanager", alerts=len(alerts)):
             for alert in alerts:
                 ALERTS_RECEIVED.inc(source="alertmanager")
@@ -164,6 +228,17 @@ class ApiHandler(BaseHTTPRequestHandler):
             self._json(429, {"error": "rate limit exceeded"})
             return
         payload = self._body()
+        t_parse = time.perf_counter() - t0
+        if getattr(self.app.settings, "ingest_columnar", False):
+            from .columnar import normalize_grafana_batch
+            with TRACER.span("webhook.grafana"):
+                created, duplicates = self._columnar_webhook(
+                    "grafana",
+                    lambda: normalize_grafana_batch(payload), t_parse)
+            WEBHOOK_LATENCY.observe(time.perf_counter() - t0,
+                                    endpoint="grafana")
+            self._json(200, {"created": created, "duplicates": duplicates})
+            return
         created, duplicates = [], 0
         with TRACER.span("webhook.grafana"):
             for spec in AlertNormalizer.normalize_grafana(payload):

@@ -18,7 +18,10 @@ from __future__ import annotations
 import threading
 import time
 
+import numpy as np
+
 from ..config import Settings, get_settings
+from ..observability import metrics as obs_metrics
 
 
 class TTLSet:
@@ -56,10 +59,156 @@ class TTLSet:
             return len(dead)
 
 
+class FingerprintRing:
+    """graft-intake: bounded hashed fingerprint window for dedup.
+
+    Open-addressed ``(hash, expiry)`` slot arrays (capacity rounded up to
+    a power of two) replacing the unbounded dict TTL store on the
+    columnar path: every op is O(probes), batch membership checks are
+    VECTORIZED (one array compare per probe round over the whole batch —
+    the storm-shaped operation), and memory is fixed. A full probe
+    neighborhood evicts its oldest-expiry entry, counted in
+    ``aiops_ingest_dedup_evictions_total``; live-slot occupancy feeds the
+    ``aiops_ingest_dedup_window_occupancy`` gauge. Fingerprints are
+    identified by their leading 64 hash bits — a collision reads as a
+    duplicate (an alert suppressed for one TTL), the same fail-closed
+    trade the reference's fingerprint truncation already makes.
+    """
+
+    _TOMBSTONE = np.uint64(0)     # empty-or-released slot
+
+    def __init__(self, capacity: int = 32768, probes: int = 8,
+                 clock=time.monotonic) -> None:
+        cap = 1
+        while cap < max(int(capacity), probes * 2):
+            cap *= 2
+        self._mask = np.uint64(cap - 1)
+        self.capacity = cap
+        self.probes = int(probes)
+        self._clock = clock
+        self._hash = np.zeros(cap, np.uint64)
+        self._expiry = np.zeros(cap, np.float64)
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _h(fingerprint: str) -> np.uint64:
+        # leading 64 bits of the (already sha256-derived) hex fingerprint;
+        # 0 is reserved as the empty marker, so only the single value 0
+        # remaps (an |1-style trick would collapse even/odd hash pairs)
+        v = int(str(fingerprint)[:16], 16)
+        return np.uint64(v if v else 0x9E3779B97F4A7C15)
+
+    def _hash_batch(self, fingerprints) -> np.ndarray:
+        """Per-unique hashing: a storm batch repeats few fingerprints."""
+        fps = np.asarray(fingerprints, dtype=object)
+        uniq, inv = np.unique(fps, return_inverse=True)
+        hu = np.fromiter((self._h(u) for u in uniq), np.uint64,
+                         count=len(uniq))
+        return hu[inv]
+
+    # -- single-key API (AlertDeduplicator back-compat surface) -----------
+
+    def __contains__(self, fingerprint: str) -> bool:
+        return bool(self.contains_batch([fingerprint])[0])
+
+    def add(self, fingerprint: str, ttl_s: float) -> None:
+        now = self._clock()
+        with self._lock:
+            self._add_one(self._h(fingerprint), now + ttl_s, now)
+            obs_metrics.INGEST_DEDUP_OCCUPANCY.set(
+                float(((self._hash != self._TOMBSTONE)
+                       & (self._expiry >= now)).sum()))
+
+    def discard(self, fingerprint: str) -> None:
+        h = self._h(fingerprint)
+        base, mask = int(h), int(self._mask)
+        with self._lock:
+            for p in range(self.probes):
+                slot = (base + p) & mask
+                if self._hash[slot] == h:
+                    self._hash[slot] = self._TOMBSTONE
+                    self._expiry[slot] = 0.0
+                    return
+
+    # -- batch API (the columnar ingest edge) ------------------------------
+
+    def contains_batch(self, fingerprints) -> np.ndarray:
+        """[B] bool duplicate mask: one vectorized slot compare per probe
+        round over the whole batch."""
+        if len(fingerprints) == 0:
+            return np.zeros(0, bool)
+        h = self._hash_batch(fingerprints)
+        now = self._clock()
+        hit = np.zeros(len(h), bool)
+        with self._lock:
+            for p in range(self.probes):
+                slots = ((h + np.uint64(p)) & self._mask).astype(np.int64)
+                hit |= (self._hash[slots] == h) & (self._expiry[slots] >= now)
+        return hit
+
+    def add_batch(self, fingerprints, ttl_s: float) -> None:
+        if len(fingerprints) == 0:
+            return
+        h = self._hash_batch(fingerprints)
+        now = self._clock()
+        exp = now + ttl_s
+        with self._lock:
+            for hv in h:
+                self._add_one(hv, exp, now)
+            obs_metrics.INGEST_DEDUP_OCCUPANCY.set(
+                float(((self._hash != self._TOMBSTONE)
+                       & (self._expiry >= now)).sum()))
+
+    def _add_one(self, h: np.uint64, exp: float, now: float) -> None:
+        """Place one hash: refresh an existing live slot, else the first
+        free/expired slot in the probe neighborhood, else evict the
+        neighborhood's oldest-expiry entry (counted). Caller holds the
+        lock."""
+        free = -1
+        oldest_slot, oldest_exp = -1, np.inf
+        base, mask = int(h), int(self._mask)
+        for p in range(self.probes):
+            slot = (base + p) & mask
+            if self._hash[slot] == h:
+                self._expiry[slot] = exp
+                return
+            e = self._expiry[slot]
+            if free < 0 and (self._hash[slot] == self._TOMBSTONE
+                             or e < now):
+                free = slot
+            if e < oldest_exp:
+                oldest_slot, oldest_exp = slot, e
+        if free < 0:
+            free = oldest_slot
+            self.evictions += 1
+            obs_metrics.INGEST_DEDUP_EVICTIONS.inc()
+        self._hash[free] = h
+        self._expiry[free] = exp
+
+    def occupancy(self) -> int:
+        now = self._clock()
+        with self._lock:
+            return int(((self._hash != self._TOMBSTONE)
+                        & (self._expiry >= now)).sum())
+
+
 class AlertDeduplicator:
+    """Dedup facade over the TTL window. With ``settings.ingest_columnar``
+    the window is the hashed :class:`FingerprintRing` (bounded, batch
+    probes for the columnar ingest edge); without it, the original dict
+    :class:`TTLSet` — the behavioral oracle the contract tests compare
+    against."""
+
     def __init__(self, settings: Settings | None = None, clock=time.monotonic) -> None:
         self.settings = settings or get_settings()
-        self._seen = TTLSet(clock)
+        self._seen: "TTLSet | FingerprintRing"
+        if getattr(self.settings, "ingest_columnar", False):
+            self._seen = FingerprintRing(
+                capacity=getattr(self.settings, "ingest_dedup_window", 32768),
+                clock=clock)
+        else:
+            self._seen = TTLSet(clock)
 
     def check_duplicate(self, fingerprint: str) -> bool:
         try:
@@ -73,6 +222,29 @@ class AlertDeduplicator:
     def release(self, fingerprint: str) -> None:
         """Allow re-alerting once an incident resolves."""
         self._seen.discard(fingerprint)
+
+    # -- batch surface (columnar ingest edge; graft-intake) ---------------
+
+    def check_batch(self, fingerprints) -> np.ndarray:
+        """[B] bool duplicate mask. Vectorized on the ring; the TTLSet
+        oracle answers per key (fail-open per row, like check_duplicate)."""
+        ring = self._seen
+        if isinstance(ring, FingerprintRing):
+            try:
+                return ring.contains_batch(fingerprints)
+            except Exception:  # graft-audit: allow[broad-except] fail open: dedup errors must not drop alerts
+                return np.zeros(len(fingerprints), bool)
+        return np.array([self.check_duplicate(f) for f in fingerprints],
+                        bool)
+
+    def register_batch(self, fingerprints) -> None:
+        ttl = self.settings.dedup_ttl_seconds
+        ring = self._seen
+        if isinstance(ring, FingerprintRing):
+            ring.add_batch(fingerprints, ttl)
+            return
+        for f in fingerprints:
+            ring.add(f, ttl)
 
 
 class RateLimiter:

@@ -204,6 +204,45 @@ WORKFLOW_STEPS = REGISTRY.counter(
     "Workflow step outcomes by status (completed|failed) — feeds the "
     "WorkflowFailures alert rule")
 
+# graft-intake instrumentation (ingestion/columnar.py + the columnar
+# staging path in rca/streaming.py): the webhook→staged-delta segment,
+# previously the one part of the serving path with no metric surface.
+INGEST_ROWS = REGISTRY.counter(
+    "aiops_ingest_rows_total",
+    "Webhook alert rows through the columnar ingest edge, by source and "
+    "outcome (created | duplicate | not_firing | malformed)")
+INGEST_ROWS_PER_SEC = REGISTRY.gauge(
+    "aiops_ingest_rows_per_sec",
+    "Rows/s through the most recent columnar webhook batch "
+    "(batch rows / parse+normalize+dedup wall), by source")
+INGEST_BATCH_FILL = REGISTRY.gauge(
+    "aiops_ingest_batch_fill",
+    "Fill fraction of the most recent staged buffer, by site: webhook = "
+    "eligible rows / batch rows; delta = staged delta entries / the "
+    "_DELTA_BUCKETS rung the packed slab was sized on")
+INGEST_MALFORMED_ROWS = REGISTRY.counter(
+    "aiops_ingest_malformed_rows_total",
+    "Webhook rows masked as malformed (non-dict alert, non-dict labels, "
+    "unparseable timestamp) — masked and counted, never a 500, by source")
+INGEST_STAGE_SECONDS = REGISTRY.histogram(
+    "aiops_ingest_stage_seconds",
+    "Columnar ingest stage durations per webhook batch "
+    "(parse | normalize | dedup | persist), by stage/source",
+    buckets=_DEFAULT_BUCKETS)
+INGEST_DEDUP_HITS = REGISTRY.counter(
+    "aiops_ingest_dedup_hits_total",
+    "Batch dedup probe hits (rows suppressed as duplicates by the "
+    "fingerprint window) — with aiops_ingest_rows_total this is the "
+    "dedup hit ratio, by source")
+INGEST_DEDUP_EVICTIONS = REGISTRY.counter(
+    "aiops_ingest_dedup_evictions_total",
+    "Live fingerprints evicted from a full hashed-ring probe "
+    "neighborhood before their TTL (window pressure)")
+INGEST_DEDUP_OCCUPANCY = REGISTRY.gauge(
+    "aiops_ingest_dedup_window_occupancy",
+    "Live (unexpired) fingerprint slots resident in the hashed dedup "
+    "ring")
+
 # Serving-pipeline instrumentation (graft-pipeline, rca/streaming.py):
 # the double-buffered executor that overlaps host delta staging with
 # device ticks and defers device_get to the caller boundary.

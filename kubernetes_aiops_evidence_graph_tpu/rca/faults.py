@@ -11,6 +11,10 @@ Stages (where the hooks fire):
 * ``dispatch``       — after the pending deltas are packed and drained,
                        before the fused tick runs (the staged values are
                        lost: recovery MUST replay, a bare retry cannot)
+* ``pack``           — graft-intake: the PACKED delta buffers exist (the
+                       columnar staged slab / the packed int payload) but
+                       the tick has not run; deltas are already drained,
+                       so this is dispatch-class — journal replay only
 * ``execute``        — after the tick ran and the donated handles were
                        swapped (a device error / preemption mid-pipeline);
                        ``device_loss`` additionally corrupts the resident
@@ -40,7 +44,7 @@ from ..observability import get_logger
 
 log = get_logger("shield.faults")
 
-STAGES = ("staging", "dispatch", "execute", "fetch",
+STAGES = ("staging", "dispatch", "pack", "execute", "fetch",
           "journal_append", "snapshot_write", "delta_values")
 
 # value-corruption stages return poisoned data instead of raising

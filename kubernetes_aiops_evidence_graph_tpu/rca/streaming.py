@@ -139,6 +139,152 @@ def _pack_ints_sharded(f_idx, r_idx, r_cnt, r_ev, r_pair) -> np.ndarray:
         axis=1).astype(np.int32, copy=False)
 
 
+@partial(jax.jit, static_argnames=("li", "pk", "dim"))
+def _delta_pack(slab, li: int, pk: int, dim: int):
+    """graft-intake: split one staged int32 slab into the fused tick's
+    ``(ints, f_rows)`` operands ON DEVICE. The columnar staging path
+    (``_staged_delta_columnar``) assembles the whole tick delta — the
+    packed integer payload AND the [pk, DIM] float feature rows (written
+    through an int32 view, bit-exact) — into a single preallocated host
+    slab, so each tick pays ONE host→device transfer instead of two
+    (PR 1 cut 6 transfers to 2 the same way; this removes the last
+    split). Zero FLOPs: a slice and an elementwise bitcast; registered as
+    the ``ingest.delta_pack`` audit entrypoint with a zero-collective
+    CostSpec."""
+    ints = slab[:li]
+    rows = jax.lax.bitcast_convert_type(
+        slab[li:li + pk * dim].reshape(pk, dim), jnp.float32)
+    return ints, rows
+
+
+class FeatureStage:
+    """graft-intake: columnar pending-feature staging.
+
+    Replaces the ``_pending_feat`` dict of per-row np arrays with two
+    preallocated columns — ``[cap]`` int32 node rows + ``[cap, DIM]``
+    float32 feature rows — plus a row→slot map for the latest-wins
+    contract (an updated row overwrites its slot IN PLACE, keeping its
+    original position, exactly like a dict key update). Draining into
+    the tick's staged slab is then two array copies (a memcpy) instead
+    of a Python loop building ``list(dict.values())`` + ``np.stack``.
+
+    The dict surface (``keys/values/items/len/in/iter/clear/get``) is
+    preserved so every existing consumer — the sharded delta router, the
+    GNN tick's aux-row capture, the multi-tenant pack's heal/queue-depth
+    paths, the shield's host-state pickle — works on either
+    representation; insertion order is identical to the dict path, which
+    is what keeps the staged buffers bit-identical to the oracle."""
+
+    def __init__(self, dim: int, capacity: int = _DELTA_BUCKETS[0]) -> None:
+        self._dim = int(dim)
+        cap = max(int(capacity), 1)
+        self._idx = np.empty(cap, np.int32)
+        self._rows = np.empty((cap, self._dim), np.float32)
+        self._slots: dict[int, int] = {}
+        self._n = 0
+
+    def _grow_cap(self) -> None:
+        cap = len(self._idx) * 2
+        idx = np.empty(cap, np.int32)
+        rows = np.empty((cap, self._dim), np.float32)
+        idx[:self._n] = self._idx[:self._n]
+        rows[:self._n] = self._rows[:self._n]
+        self._idx, self._rows = idx, rows
+
+    def __setitem__(self, row: int, feats) -> None:
+        s = self._slots.get(row)
+        if s is None:
+            if self._n == len(self._idx):
+                self._grow_cap()
+            s = self._n
+            self._slots[row] = s
+            self._idx[s] = row
+            self._n += 1
+        self._rows[s] = feats
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __contains__(self, row: int) -> bool:
+        return row in self._slots
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def keys(self) -> list[int]:
+        return [int(r) for r in self._idx[:self._n]]
+
+    def values(self) -> list[np.ndarray]:
+        return [self._rows[s] for s in range(self._n)]
+
+    def items(self) -> list[tuple[int, np.ndarray]]:
+        return [(int(self._idx[s]), self._rows[s])
+                for s in range(self._n)]
+
+    def get(self, row: int, default=None):
+        s = self._slots.get(row)
+        return default if s is None else self._rows[s]
+
+    def clear(self) -> None:
+        self._slots.clear()
+        self._n = 0
+
+    def discard_range(self, lo: int, hi: int) -> int:
+        """Drop every staged row in ``[lo, hi)`` (tenant quarantine,
+        rca/surge.py) with one vectorized compaction; relative order of
+        the surviving rows is preserved. Returns rows dropped."""
+        k = self._n
+        idx = self._idx[:k]
+        keep = (idx < lo) | (idx >= hi)
+        m = int(keep.sum())
+        if m == k:
+            return 0
+        self._idx[:m] = idx[keep]
+        self._rows[:m] = self._rows[:k][keep]
+        self._n = m
+        self._slots = {int(r): j for j, r in enumerate(self._idx[:m])}
+        return k - m
+
+    def drain_into(self, idx_out: np.ndarray, rows_out: np.ndarray,
+                   sentinel: int) -> int:
+        """The memcpy: copy the staged columns into the tick's padded
+        delta views (tail = out-of-range sentinel indices + zero rows,
+        bit-identical to the dict oracle's padding) and reset. Returns
+        the live count."""
+        k = self._n
+        idx_out[:k] = self._idx[:k]
+        idx_out[k:] = sentinel
+        rows_out[:k] = self._rows[:k]
+        rows_out[k:] = 0.0
+        self._slots.clear()
+        self._n = 0
+        return k
+
+
+class _SlabPool:
+    """Rotating preallocated int32 staging slabs, keyed by length.
+
+    ``jnp.asarray`` may alias a host buffer zero-copy on backends that
+    support it, so a slab must not be rewritten while a tick that staged
+    from it can still be executing; rotating ``copies`` slabs per length
+    (pipeline depth + slack) bounds reuse strictly below the executor's
+    maximum in-flight window."""
+
+    def __init__(self, copies: int) -> None:
+        self.copies = max(int(copies), 2)
+        self._pools: dict[int, tuple[list[np.ndarray], int]] = {}
+
+    def acquire(self, n: int) -> np.ndarray:
+        slabs, nxt = self._pools.get(n, ([], 0))
+        if len(slabs) < self.copies:
+            slab = np.zeros(n, np.int32)
+            slabs.append(slab)
+            self._pools[n] = (slabs, 0)
+            return slab
+        self._pools[n] = (slabs, (nxt + 1) % self.copies)
+        return slabs[nxt]
+
+
 # Bound interpreter exit on ANY path, including scripts that use
 # auto_warm_growth directly and never call app.stop()/worker.drain():
 # threading._shutdown joins non-daemon threads BEFORE ordinary atexit
@@ -238,6 +384,12 @@ class StreamingScorer:
         # fetch the NEWEST tick once and drop superseded results unfetched.
         self.pipeline_depth = max(1, int(getattr(
             self.settings, "serve_pipeline_depth", 2)))
+        # graft-intake: rotating device-ready staging slabs for the
+        # columnar delta pack (one int32 buffer per tick = ints + bitcast
+        # feature rows). Sized strictly above the executor's maximum
+        # in-flight window so a slab is never rewritten under a tick that
+        # staged from it.
+        self._stage_pool = _SlabPool(self.pipeline_depth + 3)
         self._inflight: collections.deque = collections.deque()
         self._coalesce_bound = _DELTA_BUCKETS[-1]
         self.coalesced_ticks = 0
@@ -378,11 +530,19 @@ class StreamingScorer:
         self._chain0 = jnp.zeros((pi,), jnp.float32)
         self._apply_sharding()
 
-        # pending deltas. The feature delta is a dict keyed by node row so
-        # the LATEST update per row wins: XLA scatter-set order for
-        # duplicate indices is unspecified, so a remove-then-reuse of the
-        # same row within one tick must collapse to one entry (ADVICE r2).
-        self._pending_feat: dict[int, np.ndarray] = {}
+        # pending deltas. The feature delta is keyed by node row so the
+        # LATEST update per row wins: XLA scatter-set order for duplicate
+        # indices is unspecified, so a remove-then-reuse of the same row
+        # within one tick must collapse to one entry (ADVICE r2).
+        # graft-intake: with settings.ingest_columnar the dict of per-row
+        # arrays becomes a FeatureStage — preallocated columnar buffers
+        # whose drain is a memcpy into the device-ready staged slab; the
+        # dict path stays as the bit-parity oracle.
+        if getattr(self.settings, "ingest_columnar", False):
+            self._pending_feat: "dict[int, np.ndarray] | FeatureStage" = \
+                FeatureStage(snap.features.shape[1])
+        else:
+            self._pending_feat = {}
         self._dirty_rows: set[int] = set()
 
     # -- slot-space seams (graft-surge) ------------------------------------
@@ -1061,6 +1221,38 @@ class StreamingScorer:
             r_ev[:k], r_cnt[:k], r_pair[:k] = ev_idx, ev_cnt, ev_pair
         return r_idx, r_ev, r_cnt, r_pair
 
+    def _staged_delta_columnar(self):
+        """graft-intake: drain pending deltas into ONE device-ready int32
+        slab — layout ``[f_idx | r_idx | r_cnt | r_ev | r_pair |
+        f_rows.bitcast(int32)]``, the exact ``_pack_ints`` prefix followed
+        by the feature rows, so the jitted ``_delta_pack`` splits it on
+        device and the tick pays a single host→device transfer. The
+        feature segment fills by FeatureStage.drain_into (a memcpy); the
+        (small) row-delta arrays copy into their slab segments. Returns
+        ``(slab, f_idx_view, f_rows_view, li, pk, rk)``; the views alias
+        the slab, so the fault/screen seams edit the staged bytes the
+        device will actually read."""
+        stage = self._pending_feat
+        pn = self.snapshot.padded_nodes
+        dim = self.snapshot.features.shape[1]
+        width = self.width
+        k = len(stage)
+        pk = bucket_for(max(k, 1), _DELTA_BUCKETS)
+        r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+        rk = len(r_idx)
+        li = pk + 2 * rk + 2 * rk * width
+        slab = self._stage_pool.acquire(li + pk * dim)
+        f_idx = slab[:pk]
+        slab[pk:pk + rk] = r_idx
+        slab[pk + rk:pk + 2 * rk] = r_cnt
+        off = pk + 2 * rk
+        slab[off:off + rk * width] = r_ev.ravel()
+        slab[off + rk * width:li] = r_pair.ravel()
+        f_rows = slab[li:].view(np.float32).reshape(pk, dim)
+        stage.drain_into(f_idx, f_rows, pn)
+        obs_metrics.INGEST_BATCH_FILL.set(k / pk, site="delta")
+        return slab, f_idx, f_rows, li, pk, rk
+
     def warm(self, delta_sizes: tuple[int, ...] = (64, 256),
              row_sizes: tuple[int, ...] = (4, 16),
              include_next_width: bool = False) -> None:
@@ -1086,6 +1278,7 @@ class StreamingScorer:
             shardings = self._shardings(pn, pi) if sharded else None
             gshards = (self._graph_size()
                        if self._graph_sharded(pn, pi) else 1)
+            columnar = isinstance(self._pending_feat, FeatureStage)
         next_w = next((w for w in _PAIR_WIDTH_BUCKETS if w > cur_w), cur_w)
         widths = [cur_width]
         if include_next_width:
@@ -1123,6 +1316,14 @@ class StreamingScorer:
                     r_idx = np.full(rk, pi, dtype=np.int32)
                     r_ev = np.zeros((rk, width), np.int32)
                     r_cnt = np.zeros(rk, np.int32)
+                    if gshards == 1 and columnar:
+                        # graft-intake: the columnar dispatch runs
+                        # _delta_pack before the tick — pre-compile its
+                        # (li, pk, dim) variant too, or the first real
+                        # tick at this combo pays the compile mid-serve
+                        li = pk + 2 * rk + 2 * rk * width
+                        _delta_pack(jnp.zeros(li + pk * dim, jnp.int32),
+                                    li=li, pk=pk, dim=dim)
                     for pw in {cur_w, next_w}:
                         if self._warm_stop:
                             return
@@ -1210,6 +1411,7 @@ class StreamingScorer:
         auto re-arm on every shape change when ``auto_warm_growth`` is
         set); stop_warm() bounds shutdown to the one in-flight compile."""
         pks, rks = self._growth_warm_buckets()
+        columnar = isinstance(self._pending_feat, FeatureStage)
         for cpn, cpi, width, pw, dim in self._growth_shape_combos():
             sharded = self._sharded(cpi)
             shardings = self._shardings(cpn, cpi) if sharded else None
@@ -1257,6 +1459,13 @@ class StreamingScorer:
                             np.zeros((rk, width), np.int32),
                             np.full((rk, width), pw, np.int32))
                         f_rows = np.zeros((pk, dim), np.float32)
+                        if columnar:
+                            # pre-compile the matching _delta_pack split
+                            # (the columnar dispatch runs it pre-tick)
+                            li = pk + 2 * rk + 2 * rk * width
+                            _delta_pack(
+                                jnp.zeros(li + pk * dim, jnp.int32),
+                                li=li, pk=pk, dim=dim)
                     self._tick_fn(cpn, cpi, width, pw, pk=pk, rk=rk)(
                         feats, jnp.asarray(ints),
                         jnp.asarray(f_rows), *tables, chain)
@@ -1342,29 +1551,71 @@ class StreamingScorer:
             self._scope_coalesced_since = 0
         sharded = self._graph_sharded(self.snapshot.padded_nodes,
                                       self.snapshot.padded_incidents)
-        if sharded:
+        # graft-intake: the columnar staging path drains the FeatureStage
+        # with a memcpy into ONE device-ready int32 slab (packed ints +
+        # bitcast feature rows); the dict path below is the bit-parity
+        # oracle. Sharded serving keeps the per-shard routed layout (its
+        # ints are [G, L]; routing stays the per-shard delta story).
+        columnar = (not sharded
+                    and isinstance(self._pending_feat, FeatureStage))
+        slab = None
+        if columnar:
+            slab, f_idx, f_rows, slab_li, pk, rk = \
+                self._staged_delta_columnar()
+        elif sharded:
             f_idx, f_rows = self._pending_feature_delta_sharded(
                 self._graph_size())
+            r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
         else:
             f_idx, f_rows = self._pending_feature_delta()
-        r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+            r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
+        if span is not None:
+            # sub-mark: host delta drain + row materialization + (on the
+            # columnar path) the packed-slab assembly — the "pack" half
+            # of what used to be one opaque staging segment
+            span.mark("pack")
         # graft-shield hooks: value poisoning lands on the STAGED rows
         # (the host copy in self.snapshot stays clean — store-truth), and
         # the dispatch fault fires after the pending deltas were drained,
         # so a bare retry cannot restage them: journal replay must
-        f_rows = self._fault_value("delta_values", f_rows)
-        f_idx, f_rows = self._screen_delta(f_idx, f_rows, span)
-        self._fault_point("dispatch")
-        if sharded:
-            ints = _pack_ints_sharded(f_idx, r_idx, r_cnt, r_ev, r_pair)
+        poisoned = self._fault_value("delta_values", f_rows)
+        if poisoned is not f_rows:
+            if columnar:
+                # keep the slab authoritative: the poison must ride the
+                # PACKED buffer the device actually reads, or the chaos
+                # suite would prove nothing about the columnar path
+                f_rows[...] = poisoned
+            else:
+                f_rows = poisoned
+        s_idx, s_rows = self._screen_delta(f_idx, f_rows, span)
+        if columnar and (s_idx is not f_idx or s_rows is not f_rows):
+            # the multi-tenant screen returns edited copies (quarantined
+            # rows sentineled) — fold them back into the staged slab
+            f_idx[...], f_rows[...] = s_idx, s_rows
         else:
-            ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
+            f_idx, f_rows = s_idx, s_rows
+        self._fault_point("dispatch")
+        if not columnar:
+            if sharded:
+                ints = _pack_ints_sharded(f_idx, r_idx, r_cnt, r_ev, r_pair)
+            else:
+                ints = _pack_ints(f_idx, r_idx, r_cnt, r_ev, r_pair)
+            pk, rk = f_idx.shape[-1], len(r_idx)
+        # the packed buffers exist now on either path: the staging fault
+        # class extends to them (a lost pack is dispatch-like — deltas
+        # are drained, only journal replay can restage)
+        self._fault_point("pack")
         tick = self._tick_fn(self.snapshot.padded_nodes,
                              self.snapshot.padded_incidents,
                              self.width, self.pair_width,
-                             pk=f_idx.shape[-1], rk=len(r_idx))
-        ints_dev = jnp.asarray(ints)
-        rows_dev = jnp.asarray(f_rows)
+                             pk=pk, rk=rk)
+        if columnar:
+            ints_dev, rows_dev = _delta_pack(
+                jnp.asarray(slab), li=slab_li, pk=pk,
+                dim=self.snapshot.features.shape[1])
+        else:
+            ints_dev = jnp.asarray(ints)
+            rows_dev = jnp.asarray(f_rows)
         args = (self._features_dev, ints_dev, rows_dev,
                 self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
                 self._chain0)
@@ -1377,7 +1628,7 @@ class StreamingScorer:
             self._scope_key = (self.snapshot.padded_nodes,
                                self.snapshot.padded_incidents,
                                self.width, self.pair_width,
-                               f_idx.shape[-1], len(r_idx), sharded)
+                               pk, rk, sharded)
             self._scope_entry = self._scope_entrypoint(sharded)
             obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
                                      tick, args)

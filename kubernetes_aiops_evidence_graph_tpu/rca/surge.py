@@ -219,7 +219,14 @@ class MultiTenantScorer(StreamingScorer):
         self._pair_dev = jnp.asarray(ev_pair)
         self._chain0 = jnp.zeros((pi,), jnp.float32)
         self._apply_sharding()
-        self._pending_feat = {}
+        # graft-intake: same columnar/dict staging switch as the base
+        # scorer's _init_from_store — the pack rides the identical drain
+        if getattr(self.settings, "ingest_columnar", False):
+            from .streaming import FeatureStage
+            self._pending_feat = FeatureStage(
+                self.snapshot.features.shape[1])
+        else:
+            self._pending_feat = {}
         self._dirty_rows = set()
         self._synced_seq = 0   # unused by the pack (per-region cursors)
 
@@ -521,8 +528,14 @@ class MultiTenantScorer(StreamingScorer):
                 "tenant_quarantined", tenant=tenant, reason=reason)
             log.warning("tenant_quarantined", tenant=tenant, reason=reason)
         nb, ne = reg.node_base, reg.node_base + reg.pn
-        self._pending_feat = {k: v for k, v in self._pending_feat.items()
-                              if not nb <= k < ne}
+        pf = self._pending_feat
+        if hasattr(pf, "discard_range"):
+            # graft-intake columnar stage: one vectorized compaction,
+            # surviving rows keep their staging order
+            pf.discard_range(nb, ne)
+        else:
+            self._pending_feat = {k: v for k, v in pf.items()
+                                  if not nb <= k < ne}
         ib, ie = reg.inc_base, reg.inc_base + reg.pi
         self._dirty_rows = {r for r in self._dirty_rows if not ib <= r < ie}
 

@@ -1,0 +1,439 @@
+"""graft-intake: columnar webhook ingest contracts.
+
+Three layers, mirroring the PR's oracle pattern (PR 1 / PR 4):
+
+1. **Normalizer row-parity** — the columnar batch normalizer
+   (ingestion/columnar.py) must produce field-identical IncidentCreate
+   specs to the dict AlertNormalizer for all three webhook formats,
+   including grafana multi-alert payload fallbacks, missing-label rows
+   and malformed rows (masked + counted, never raised).
+2. **Dedup window** — the hashed FingerprintRing answers membership
+   identically to the TTLSet oracle, its batch probe matches its scalar
+   probe, TTL expiry and release work, and a full probe neighborhood
+   evicts (counted) instead of scanning or growing.
+3. **Staged-delta bit-parity** — the columnar FeatureStage drain + the
+   device-ready staged slab are BIT-identical to the dict path's packed
+   buffers at every _DELTA_BUCKETS rung, and a full churn script (with a
+   mid-script rebuild) serves bit-identical verdicts under
+   ingest_columnar on/off.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.ingestion.columnar import (
+    normalize_alertmanager_batch, normalize_grafana_batch,
+    normalize_prometheus_batch)
+from kubernetes_aiops_evidence_graph_tpu.ingestion.dedup import (
+    AlertDeduplicator, FingerprintRing, TTLSet)
+from kubernetes_aiops_evidence_graph_tpu.ingestion.normalizer import (
+    AlertNormalizer)
+from kubernetes_aiops_evidence_graph_tpu.observability import (
+    metrics as obs_metrics)
+from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+    _DELTA_BUCKETS, FeatureStage, StreamingScorer, _delta_pack, _pack_ints)
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, stream_step)
+from tests.test_streaming import _world
+
+SPEC_FIELDS = ("fingerprint", "title", "description", "severity", "source",
+               "cluster", "namespace", "service", "labels", "annotations")
+
+
+def _assert_spec_parity(dict_spec, col_spec, ts_too=True):
+    for f in SPEC_FIELDS + (("started_at",) if ts_too else ()):
+        a, b = getattr(dict_spec, f), getattr(col_spec, f)
+        assert a == b, (f, a, b)
+
+
+def _alert(**labels):
+    ann = labels.pop("_ann", {"description": "d"})
+    starts = labels.pop("_starts", "2026-07-29T08:00:00Z")
+    a = {"status": labels.pop("_status", "firing"),
+         "labels": labels, "annotations": ann}
+    if starts is not None:
+        a["startsAt"] = starts
+    return a
+
+
+ALERTS = [
+    _alert(alertname="PodCrashLooping", namespace="ns1", service="svc-0",
+           severity="critical"),
+    # pod-name stripping + summary title + no namespace/service labels
+    _alert(alertname="HighCPU", pod="api-server-7d4f5b6c8-xyz12",
+           severity="warning", _ann={"summary": "cpu is high"}),
+    # job fallback, unknown severity, no startsAt
+    _alert(alertname="X", job="j-1", severity="weird", _starts=None),
+    # deployment subject, empty annotations, severity missing
+    _alert(alertname="Y", deployment="dep-1", _ann={}),
+    # no alertname at all (UnknownAlert title, "" fingerprint name)
+    _alert(service="svc-9", severity="info"),
+    # app label wins over job; cluster label carried
+    _alert(alertname="Z", app="app-1", job="j-2", cluster="west",
+           severity="high"),
+]
+
+
+def test_alertmanager_columnar_row_parity():
+    cols = normalize_alertmanager_batch(ALERTS)
+    assert cols.valid.all() and cols.firing.all()
+    assert cols.malformed == 0
+    specs = cols.specs(range(len(ALERTS)))
+    for i, alert in enumerate(ALERTS):
+        # started_at compared only when the payload carries it (the
+        # missing-timestamp fallback is utcnow(), distinct per call)
+        _assert_spec_parity(AlertNormalizer.normalize_alertmanager(alert),
+                            specs[i], ts_too="startsAt" in alert)
+
+
+def test_prometheus_columnar_row_parity():
+    cols = normalize_prometheus_batch(ALERTS)
+    specs = cols.specs(range(len(ALERTS)))
+    for i, alert in enumerate(ALERTS):
+        _assert_spec_parity(AlertNormalizer.normalize_prometheus(alert),
+                            specs[i], ts_too="startsAt" in alert)
+
+
+def test_grafana_columnar_multi_alert_parity():
+    payload = {
+        "title": "Grafana panel title", "message": "panel message",
+        "alerts": [
+            # empty labels: payload-title fallback + message description
+            {"labels": {}, "annotations": {}},
+            {"labels": {"alertname": "A", "namespace": "n2",
+                        "severity": "info"},
+             "annotations": {"description": "dd"},
+             "startsAt": "2026-07-29T09:00:00+00:00"},
+            # missing alertname: fingerprint defaults to the payload title
+            {"labels": {"service": "s3", "severity": "critical"},
+             "annotations": {"summary": "sum3"},
+             "startsAt": "2026-07-29T10:00:00Z"},
+        ],
+    }
+    dict_specs = AlertNormalizer.normalize_grafana(payload)
+    cols = normalize_grafana_batch(payload)
+    assert cols.firing.all()     # grafana path has no status filter
+    col_specs = cols.specs(range(len(dict_specs)))
+    for ds, cs, raw in zip(dict_specs, col_specs, payload["alerts"]):
+        _assert_spec_parity(ds, cs, ts_too="startsAt" in raw)
+    # no-title payload falls back to "Grafana alert" like the dict path
+    p2 = {"alerts": [{"labels": {}, "annotations": {}}]}
+    d2 = AlertNormalizer.normalize_grafana(p2)[0]
+    c2 = normalize_grafana_batch(p2).specs([0])[0]
+    _assert_spec_parity(d2, c2, ts_too=False)
+
+
+def test_malformed_rows_masked_not_raised():
+    m0 = obs_metrics.INGEST_MALFORMED_ROWS.value(source="alertmanager")
+    batch = [
+        ALERTS[0],
+        "not-a-dict",
+        {"status": "firing", "labels": "not-a-dict", "annotations": {}},
+        _alert(alertname="T", _starts="not a timestamp"),
+        _alert(alertname="OK", namespace="ns9"),
+    ]
+    cols = normalize_alertmanager_batch(batch)   # must not raise
+    assert list(cols.valid) == [True, False, False, False, True]
+    assert cols.malformed == 3
+    specs = cols.specs()
+    assert len(specs) == 2
+    assert {s.fingerprint for s in specs} == {
+        AlertNormalizer.normalize_alertmanager(batch[0]).fingerprint,
+        AlertNormalizer.normalize_alertmanager(batch[4]).fingerprint}
+    # non-firing rows are eligible-masked, not malformed
+    cols2 = normalize_alertmanager_batch([_alert(_status="resolved",
+                                                 alertname="R")])
+    assert cols2.valid.all() and not cols2.firing.any()
+    assert cols2.malformed == 0
+
+
+# -- dedup window ------------------------------------------------------------
+
+def test_ring_matches_ttlset_oracle():
+    clock = [0.0]
+    ring = FingerprintRing(capacity=4096, clock=lambda: clock[0])
+    oracle = TTLSet(clock=lambda: clock[0])
+    rng = np.random.default_rng(7)
+    fps = [bytes(rng.bytes(16)).hex() for _ in range(300)]
+    for i, fp in enumerate(fps[:200]):
+        ttl = 100.0 + (i % 7) * 50.0
+        ring.add(fp, ttl)
+        oracle.add(fp, ttl)
+    for step in (0.0, 120.0, 300.0, 500.0):
+        clock[0] = step
+        batch = ring.contains_batch(fps)
+        for i, fp in enumerate(fps):
+            assert (fp in oracle) == bool(batch[i]), (step, i)
+            assert bool(batch[i]) == (fp in ring)   # batch == scalar probe
+    # release
+    clock[0] = 0.0
+    ring.add(fps[0], 100.0)
+    ring.discard(fps[0])
+    assert fps[0] not in ring
+
+
+def test_ring_eviction_counter_and_occupancy():
+    clock = [0.0]
+    ring = FingerprintRing(capacity=16, probes=4, clock=lambda: clock[0])
+    # hashes all landing on slot 5 of the 16-slot table: the probe
+    # neighborhood [5, 9) fills at 4 entries, the 5th EVICTS (counted)
+    fps = [format(16 * k + 5, "016x") + "0" * 16 for k in range(1, 7)]
+    e0 = obs_metrics.INGEST_DEDUP_EVICTIONS.value()
+    for fp in fps[:4]:
+        ring.add(fp, 100.0)
+    assert ring.evictions == 0
+    assert ring.occupancy() == 4
+    assert ring.contains_batch(fps[:4]).all()
+    ring.add(fps[4], 100.0)
+    assert ring.evictions == 1
+    assert obs_metrics.INGEST_DEDUP_EVICTIONS.value() == e0 + 1
+    assert fps[4] in ring                      # the new entry is resident
+    assert ring.occupancy() == 4               # bounded: no growth
+    clock[0] = 200.0
+    assert ring.occupancy() == 0               # TTL expiry empties it
+
+
+def test_dedup_facade_batch_semantics():
+    cfg = load_settings(ingest_columnar=True, dedup_ttl_seconds=100)
+    clock = [0.0]
+    d = AlertDeduplicator(cfg, clock=lambda: clock[0])
+    assert isinstance(d._seen, FingerprintRing)
+    # distinct LEADING 64 bits (the ring's identity window) per key
+    fps = [format(i + 1, "016x") + "0" * 16 for i in range(8)]
+    assert not d.check_batch(fps).any()
+    d.register_batch(fps[:4])
+    mask = d.check_batch(fps)
+    assert mask[:4].all() and not mask[4:].any()
+    assert d.check_duplicate(fps[0])
+    d.release(fps[0])
+    assert not d.check_duplicate(fps[0])
+    clock[0] = 101.0
+    assert not d.check_batch(fps).any()
+    # dict-oracle facade still answers the same surface
+    d2 = AlertDeduplicator(load_settings(ingest_columnar=False),
+                           clock=lambda: clock[0])
+    assert isinstance(d2._seen, TTLSet)
+    d2.register_batch(fps[:2])
+    assert list(d2.check_batch(fps[:3])) == [True, True, False]
+
+
+# -- columnar staging --------------------------------------------------------
+
+def test_feature_stage_dict_surface_and_latest_wins():
+    stage = FeatureStage(dim=4, capacity=2)
+    oracle: dict = {}
+    rng = np.random.default_rng(3)
+    for row in (5, 9, 5, 2, 9, 7):       # re-puts keep original position
+        vec = rng.random(4).astype(np.float32)
+        stage[row] = vec
+        oracle[row] = vec
+    assert len(stage) == len(oracle) == 4
+    assert stage.keys() == list(oracle.keys())
+    assert 5 in stage and 4 not in stage
+    np.testing.assert_array_equal(np.stack(stage.values()),
+                                  np.stack(list(oracle.values())))
+    assert [r for r, _v in stage.items()] == list(oracle.keys())
+    np.testing.assert_array_equal(stage.get(9), oracle[9])
+    # vectorized range discard keeps relative order (tenant quarantine)
+    dropped = stage.discard_range(4, 8)   # drops rows 5 and 7
+    assert dropped == 2
+    assert stage.keys() == [9, 2]
+    # drain: padded views bit-match the dict-oracle padding
+    idx = np.empty(8, np.int32)
+    rows = np.empty((8, 4), np.float32)
+    k = stage.drain_into(idx, rows, sentinel=99)
+    assert k == 2 and len(stage) == 0
+    assert list(idx) == [9, 2] + [99] * 6
+    np.testing.assert_array_equal(rows[:2],
+                                  np.stack([oracle[9], oracle[2]]))
+    assert (rows[2:] == 0.0).all()
+
+
+def _seeded_scorers(rows_per_rung):
+    """Two scorers over identical worlds — columnar and dict staging —
+    with identical synthetic pending deltas staged on both."""
+    out = []
+    for columnar in (True, False):
+        cfg = load_settings(
+            ingest_columnar=columnar, serve_pipeline_depth=2,
+            node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+            incident_bucket_sizes=(8, 32))
+        cluster, builder, _inc = _world(settings=cfg)
+        sc = StreamingScorer(builder.store, cfg,
+                             now_s=cluster.now.timestamp())
+        rng = np.random.default_rng(17)
+        for j in range(rows_per_rung):
+            sc._pending_feat[j] = rng.random(
+                sc.snapshot.features.shape[1]).astype(np.float32)
+        sc._dirty_rows.update({1, 3})
+        out.append(sc)
+    return out
+
+
+@pytest.mark.parametrize("rung", _DELTA_BUCKETS)
+def test_staged_slab_bit_identical_to_oracle_at_every_rung(rung):
+    """The acceptance pin: at every _DELTA_BUCKETS rung, the columnar
+    staged slab's packed-int prefix and bitcast feature segment are
+    BYTE-identical to the dict oracle's _pack_ints payload + stacked
+    rows — and the jitted _delta_pack splits them back bit-exactly."""
+    k = rung if rung == 1 else rung - 3   # land INSIDE the rung
+    sc_col, sc_dict = _seeded_scorers(k)
+    assert isinstance(sc_col._pending_feat, FeatureStage)
+    slab, f_idx, f_rows, li, pk, rk = sc_col._staged_delta_columnar()
+    assert pk == rung
+    # oracle drain on the twin scorer
+    o_idx, o_rows = sc_dict._pending_feature_delta()
+    r_idx, r_ev, r_cnt, r_pair = sc_dict._pending_row_delta()
+    ints = _pack_ints(o_idx, r_idx, r_cnt, r_ev, r_pair)
+    assert np.array_equal(slab[:li], ints)
+    assert slab[li:].tobytes() == o_rows.tobytes()      # bit-exact f32
+    np.testing.assert_array_equal(f_idx, o_idx)
+    # the device split restores the exact operands
+    ints_dev, rows_dev = _delta_pack(slab, li=li, pk=pk,
+                                     dim=o_rows.shape[1])
+    assert np.array_equal(np.asarray(ints_dev), ints)
+    assert np.asarray(rows_dev).tobytes() == o_rows.tobytes()
+
+
+@pytest.mark.perf_contract
+def test_columnar_verdict_bit_parity_under_churn_and_rebuild():
+    """Full-script acceptance: identical seeded churn (feature drift,
+    structural mutation, incident arrival/closure) with a forced
+    mid-script rebuild serves BIT-identical verdicts with
+    ingest_columnar on vs off, at pipeline depth 2."""
+    def run(columnar):
+        cfg = load_settings(
+            ingest_columnar=columnar, serve_pipeline_depth=2,
+            node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+            incident_bucket_sizes=(8, 32))
+        cluster, builder, incidents = _world(settings=cfg)
+        sc = StreamingScorer(builder.store, cfg,
+                             now_s=cluster.now.timestamp())
+        outs = []
+        for i, ev in enumerate(churn_events(
+                cluster, 160, seed=3,
+                incident_ids=tuple(f"incident:{x.id}"
+                                   for x in incidents))):
+            stream_step(cluster, builder.store, sc, ev)
+            sc.tick_async()
+            if i == 80:
+                sc._rebuild()          # mid-script rebuild, both arms
+            if i % 23 == 0:
+                outs.append(sc.rescore())
+        outs.append(sc.rescore())
+        return sc, outs
+
+    sc_c, a = run(True)
+    sc_d, b = run(False)
+    assert isinstance(sc_c._pending_feat, FeatureStage)
+    assert isinstance(sc_d._pending_feat, dict)
+    assert sc_c.rebuilds == sc_d.rebuilds >= 1
+    for oa, ob in zip(a, b):
+        # incident ids are per-world uuids; rows correspond by injection
+        # order (the PR 5 depth-parity convention)
+        assert len(oa["incident_ids"]) == len(ob["incident_ids"])
+        for k in ("conditions", "matched", "scores", "top_rule_index",
+                  "any_match", "top_confidence", "top_score"):
+            assert np.array_equal(np.asarray(oa[k]), np.asarray(ob[k])), k
+
+
+def test_pack_submark_and_ingest_metrics_surface():
+    """The tick's flight record splits the old opaque staging segment
+    into pack + staging sub-marks, and the aiops_ingest_* metric family
+    is registered and exposed."""
+    from kubernetes_aiops_evidence_graph_tpu.observability.scope import (
+        FLIGHT_RECORDER)
+    cfg = load_settings(
+        ingest_columnar=True, scope_telemetry=True,
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    cluster, builder, _inc = _world(settings=cfg)
+    sc = StreamingScorer(builder.store, cfg, now_s=cluster.now.timestamp())
+    for ev in churn_events(cluster, 20, seed=5, structural=False):
+        stream_step(cluster, builder.store, sc, ev)
+    sc.rescore()
+    recs = [r for r in FLIGHT_RECORDER.snapshot() if "stages_ms" in r]
+    assert recs, "no tick records in the flight ring"
+    last = recs[-1]
+    assert {"pack", "staging", "dispatch", "execute", "fetch"} <= set(
+        last["stages_ms"]), last["stages_ms"]
+    # delta staging fill gauge was stamped by the columnar drain
+    assert obs_metrics.INGEST_BATCH_FILL.value(site="delta") > 0.0
+    exposition = obs_metrics.REGISTRY.expose()
+    for name in ("aiops_ingest_rows_total", "aiops_ingest_rows_per_sec",
+                 "aiops_ingest_batch_fill",
+                 "aiops_ingest_malformed_rows_total",
+                 "aiops_ingest_stage_seconds",
+                 "aiops_ingest_dedup_hits_total",
+                 "aiops_ingest_dedup_evictions_total",
+                 "aiops_ingest_dedup_window_occupancy"):
+        assert name in exposition, name
+
+
+@pytest.mark.static_audit
+def test_delta_pack_entrypoint_registered_zero_flop():
+    """ingest.delta_pack is a registered audit entrypoint with a
+    zero-collective CostSpec and models ZERO dot FLOPs — the ingest path
+    may never grow compute implicitly."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_jaxpr)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        ENTRYPOINTS)
+    ep = {e.name: e for e in ENTRYPOINTS}["ingest.delta_pack"]
+    assert ep.cost is not None
+    fn, args = ep.build()
+    cost = cost_jaxpr("ingest.delta_pack", jax.make_jaxpr(fn)(*args))
+    assert cost.dot_flops == 0
+    assert cost.collective_bytes == 0
+
+
+def test_webhook_columnar_end_to_end_masks_malformed():
+    """The live HTTP edge on the columnar path: a storm batch with
+    malformed rows returns 200 with the good rows created, duplicates
+    suppressed by the ring, malformed masked + counted."""
+    import urllib.request
+
+    from kubernetes_aiops_evidence_graph_tpu.app import AiopsApp
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        generate_cluster)
+    cfg = load_settings(
+        app_env="development", rca_backend="cpu", db_path=":memory:",
+        ingest_columnar=True, verification_wait_seconds=0,
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    app = AiopsApp(generate_cluster(num_pods=40, seed=4), cfg)
+    port = app.start(host="127.0.0.1", port=0)
+    try:
+        batch = {"alerts": [
+            ALERTS[0], ALERTS[0],            # intra-batch duplicate
+            "garbage-row",
+            _alert(alertname="T2", _starts="zzz not a time"),
+            _alert(alertname="T3", namespace="nsX"),
+            _alert(_status="resolved", alertname="T4"),
+        ]}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/webhooks/alertmanager",
+            data=json.dumps(batch).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert resp.status == 200
+        assert len(body["created"]) == 2          # ALERTS[0] + T3
+        assert body["duplicates"] == 1            # the intra-batch repeat
+        # replay: every survivor is now a ring duplicate
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/webhooks/alertmanager",
+                data=json.dumps(batch).encode(), method="POST",
+                headers={"Content-Type": "application/json"}),
+                timeout=30) as resp:
+            body2 = json.loads(resp.read())
+        # all 3 eligible rows (both ALERTS[0] copies + T3) suppress now
+        assert body2["created"] == [] and body2["duplicates"] == 3
+        assert obs_metrics.INGEST_MALFORMED_ROWS.value(
+            source="alertmanager") >= 2
+    finally:
+        app.stop()

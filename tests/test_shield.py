@@ -141,6 +141,11 @@ def baselines():
 FAULTS = {
     "staging_exception": (Fault("staging", at=2), False),
     "dispatch_failure": (Fault("dispatch", at=2), True),
+    # graft-intake: the packed delta buffers (the columnar staged slab on
+    # the default path) are lost AFTER the pending deltas drained —
+    # dispatch-class, journal replay only; proves quarantine/recovery
+    # bit-parity holds on the columnar staging path too
+    "pack_failure": (Fault("pack", at=2), True),
     "device_loss_mid_execute": (Fault("execute", at=2, kind="device_loss"),
                                 True),
     "fetch_failure": (Fault("fetch", at=0), False),
@@ -201,7 +206,8 @@ def test_randomized_fault_schedule_sweep(baselines):
     n_ticks = EVENTS // BATCH + 1
     injector = FaultInjector.seeded(
         seed, ticks=n_ticks, rate=0.25,
-        stages=("staging", "dispatch", "execute", "journal_append"))
+        stages=("staging", "dispatch", "pack", "execute",
+                "journal_append"))
     out, shield, injected = _run_churn(2, injector=injector)
     base, injected_b = baselines[2]
     _assert_bit_parity(out, base, injected, injected_b)
