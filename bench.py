@@ -1119,6 +1119,246 @@ def bench_webhook_ingest(num_pods: int = 200, tenants: int = 4,
     }
 
 
+def bench_webhook_storm(num_pods: int = 200, tenants: int = 2,
+                        capacity_eps: int = 2000, overload_factor: int = 5,
+                        baseline_batches: int = 20, storm_batches: int = 60,
+                        recovery_batches: int = 40, batch: int = 200,
+                        churn_per_batch: int = 8, seed: int = 0,
+                        verbose: bool = True) -> dict:
+    """graft-storm: the overload record — webhook bytes → verdict at
+    ``overload_factor``× the configured sustained capacity.
+
+    Three phases over one resident MultiTenantScorer pack behind the
+    full columnar pipeline (parse → normalize → ring dedup → ADMISSION
+    → churn → absorb):
+
+    1. **baseline** — paced at ``capacity_eps`` with a duplicate-heavy
+       bounded universe (steady state: nothing sheds, storm inactive);
+       measures the unloaded absorb p99 the storm phase is judged
+       against.
+    2. **storm** — paced at ``overload_factor × capacity_eps`` with
+       ~all-UNIQUE alerts (the grey-failure shape: every row is a fresh
+       fingerprint, so the dedup ring cannot absorb the flood and the
+       admission gate is the binding constraint). ~1 row in 5 is
+       critical. Contract asserts: ZERO critical sheds, exact
+       per-severity shed accounting (eligible == duplicates + admitted
+       + shed on every batch), storm mode ENTERS (hysteresis + dwell),
+       and the absorb p99 for batches that admitted critical rows stays
+       within 2× the unloaded p99 (+1 ms CPU-jitter floor).
+    3. **recovery** — paced back at capacity on the duplicate-heavy
+       universe; counts batches until storm mode exits AND the scorer's
+       journal backlog drains — the bounded, recorded
+       recovery-to-steady-state figure.
+    """
+    import json as _json
+
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.admission import (
+        AdmissionController)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.columnar import (
+        normalize_alertmanager_batch)
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.dedup import (
+        AlertDeduplicator)
+    from kubernetes_aiops_evidence_graph_tpu.observability import (
+        scope as obs_scope)
+    from kubernetes_aiops_evidence_graph_tpu.rca.surge import (
+        MultiTenantScorer)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        SCENARIOS, generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, store_step)
+    import jax
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose \
+        else (lambda *a: None)
+    cfg = load_settings(
+        scope_telemetry=True, ingest_columnar=True, ingest_admission=True,
+        admission_rate_per_sec=capacity_eps / tenants,
+        admission_burst=capacity_eps / tenants,
+        storm_enter_shed_ratio=0.25, storm_exit_shed_ratio=0.02,
+        storm_dwell_s=0.2)
+    rng = np.random.default_rng(seed)
+    ctrl = AdmissionController(cfg)
+    dedup = AlertDeduplicator(cfg)
+
+    # -- tenant worlds (the bench_webhook_ingest shape) -------------------
+    worlds = []
+    names = sorted(SCENARIOS)
+    total_batches = baseline_batches + storm_batches + recovery_batches
+    for t in range(tenants):
+        cluster = generate_cluster(num_pods=num_pods, seed=seed + 71 + t)
+        wrng = np.random.default_rng(seed + 71 + t)
+        builder = GraphBuilder()
+        sync_topology(cluster, builder.store)
+        keys = sorted(cluster.deployments)
+        injected = []
+        for i in range(4):
+            inc = inject(cluster, names[(t + i) % len(names)],
+                         keys[(i * 5) % len(keys)], wrng)
+            injected.append(inc)
+            builder.ingest(inc, collect_all(
+                inc, default_collectors(cluster, cfg), parallel=False))
+        churn = list(churn_events(
+            cluster, total_batches * churn_per_batch, seed=seed + 171 + t,
+            incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+        worlds.append((f"tenant-{t}", cluster, builder, churn))
+    now_s = max(c.now.timestamp() for _n, c, _b, _s in worlds)
+    pack = MultiTenantScorer(
+        {name: b.store for name, _c, b, _s in worlds}, cfg, now_s=now_s)
+    pack.rescore()
+    pack.warm(delta_sizes=(64, 256), row_sizes=(4, 16, 64))
+    pack.warm_growth()
+
+    sevs = ("critical", "warning", "info", "high", "low")
+
+    def _alert(name, i, uid):
+        # ONE namespace per tenant: the admission bucket keys on the
+        # namespace column (the same tenancy the SLO histograms use), so
+        # the record exercises exactly tenants buckets
+        return {"status": "firing",
+                "labels": {"alertname": f"storm-{uid}",
+                           "namespace": name,
+                           "service": f"svc-{i % 24}",
+                           "severity": sevs[i % len(sevs)],
+                           "cluster": name},
+                "annotations": {"description": "storm"},
+                "startsAt": "2026-08-05T08:00:00Z"}
+
+    # duplicate-heavy steady universe: bounded fingerprints per tenant
+    steady_universe = [
+        _alert(name, i, f"steady-{i % 24}")
+        for name, _c, _b, _s in worlds for i in range(48)]
+    uid = [0]
+
+    def _steady_batch():
+        draws = rng.integers(0, len(steady_universe), batch)
+        return [steady_universe[j] for j in draws]
+
+    def _storm_batch():
+        # ~all-unique rows: every alert is a fresh fingerprint
+        out = []
+        for i in range(batch):
+            name = worlds[i % tenants][0]
+            uid[0] += 1
+            out.append(_alert(name, i, f"unique-{uid[0]}"))
+        return out
+
+    phases = ([("baseline", _steady_batch, capacity_eps)]
+              * baseline_batches
+              + [("storm", _storm_batch, capacity_eps * overload_factor)]
+              * storm_batches
+              + [("recovery", _steady_batch, capacity_eps)]
+              * recovery_batches)
+
+    absorb_ms = {"baseline": [], "storm": [], "recovery": []}
+    crit_absorb_ms = []            # storm batches that admitted criticals
+    accounting_exact = True
+    churn_cursor = 0
+    recovery_ticks = -1            # batches until steady state post-storm
+    storm_end_idx = baseline_batches + storm_batches
+    t_start = time.perf_counter()
+    deadline = t_start
+    for bi, (phase, make, eps) in enumerate(phases):
+        payload_bytes = _json.dumps({"alerts": make()}).encode()
+        payload = _json.loads(payload_bytes)
+        cols = normalize_alertmanager_batch(payload["alerts"])
+        elig = np.flatnonzero(cols.eligible)
+        fps = cols.fingerprint[elig]
+        dup = dedup.check_batch(fps)
+        admit, _retry = ctrl.admit_batch(
+            cols.namespace[elig], cols.severity_code[elig],
+            chargeable=~dup)
+        fresh_admitted = ~dup & admit
+        if fresh_admitted.any():
+            dedup.register_batch([str(f) for f in fps[fresh_admitted]])
+        # exact bookkeeping: every eligible row is duplicate, admitted
+        # or shed — no row may vanish unaccounted
+        if len(elig) != int(dup.sum()) + int(fresh_admitted.sum()) + \
+                int((~admit & ~dup).sum()):
+            accounting_exact = False
+        for _name, cluster, builder, churn in worlds:
+            for ev in churn[churn_cursor:churn_cursor + churn_per_batch]:
+                store_step(cluster, builder.store, ev)
+        churn_cursor += churn_per_batch
+        t0 = time.perf_counter()
+        pack.absorb()
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        absorb_ms[phase].append(dt_ms)
+        if phase == "storm" and bool(
+                (cols.severity_code[elig][fresh_admitted] == 0).any()):
+            crit_absorb_ms.append(dt_ms)
+        if (bi + 1) % 8 == 0:
+            pack.serve(newest=True)
+        if phase == "recovery" and recovery_ticks < 0 and \
+                not ctrl.storm.active and pack._journal_backlog() == 0:
+            recovery_ticks = bi - storm_end_idx + 1
+        deadline += batch / float(eps)
+        spare = deadline - time.perf_counter()
+        if spare > 0:
+            time.sleep(spare)
+    pack.serve(newest=True)
+    pack.stop_warm()
+    obs_scope.STORM_FLAG["active"] = False      # process-global hygiene
+
+    st = ctrl.stats()
+    p99_base = float(np.percentile(absorb_ms["baseline"], 99))
+    p99_crit = float(np.percentile(crit_absorb_ms, 99)) \
+        if crit_absorb_ms else 0.0
+    # 2× the unloaded p99 with a 1 ms floor: CPU timer jitter must not
+    # fail a bound that the TPU-relevant claim (host path robustness
+    # under 5× inflow) comfortably meets
+    p99_bound = 2.0 * p99_base + 1.0
+    recovered = recovery_ticks >= 0
+    critical_shed_zero = st["critical_shed"] == 0
+    p99_bounded = bool(crit_absorb_ms) and p99_crit <= p99_bound
+    log(f"webhook_storm: {overload_factor}x of {capacity_eps} ev/s × "
+        f"{tenants} tenants; shed {st['shed']} (critical {st['critical_shed']}), "
+        f"storm entries {st['storm_entries']}/exits {st['storm_exits']}; "
+        f"admitted-critical absorb p99 {p99_crit:.2f} ms vs unloaded "
+        f"{p99_base:.2f} ms (bound {p99_bound:.2f}); recovery "
+        f"{recovery_ticks} batches")
+    return {
+        "metric": "webhook_storm",
+        "value": round(p99_crit, 3),
+        "unit": f"ms p99 admitted-critical absorb @{overload_factor}x "
+                f"of {capacity_eps} ev/s × {tenants} tenants",
+        "vs_baseline": round(p99_crit / max(p99_base, 1e-9), 3),
+        "capacity_eps": capacity_eps,
+        "overload_factor": overload_factor,
+        "tenants": tenants,
+        "batches": {"baseline": baseline_batches, "storm": storm_batches,
+                    "recovery": recovery_batches, "batch_rows": batch},
+        "admitted": st["admitted"],
+        "shed": st["shed"],
+        "shed_by_severity": {str(k): v
+                             for k, v in st["shed_by_severity"].items()},
+        "critical_shed": st["critical_shed"],
+        "critical_shed_zero": critical_shed_zero,
+        "accounting_exact": accounting_exact,
+        "storm_entries": st["storm_entries"],
+        "storm_exits": st["storm_exits"],
+        "storm_entered": st["storm_entries"] >= 1,
+        "p99_unloaded_absorb_ms": round(p99_base, 3),
+        "p99_admitted_critical_absorb_ms": round(p99_crit, 3),
+        "p99_bound_ms": round(p99_bound, 3),
+        "p99_bounded": p99_bounded,
+        "p99_storm_absorb_ms": round(
+            float(np.percentile(absorb_ms["storm"], 99)), 3)
+        if absorb_ms["storm"] else 0.0,
+        "recovered": recovered,
+        "recovery_ticks": recovery_ticks,
+        "storm_coalesced_ticks": int(pack.storm_coalesced_ticks),
+        "absorb_busy": int(pack.absorb_busy),
+        "tick_dispatches": int(pack.dispatches),
+        "platform": jax.default_backend(),
+    }
+
+
 def _sharded_tick_census(scorer) -> dict:
     """Modeled per-tick collective census of the EXACT tick the sharded
     scorer dispatches at its live shapes: trace the tick's jaxpr and run
@@ -1828,6 +2068,16 @@ def run_config(cfg: int, args) -> dict:
                 "metric": "webhook_ingest",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
+        # graft-storm overload record: 5× sustained capacity through
+        # admission + storm mode — zero critical sheds, exact shed
+        # accounting, bounded admitted-critical p99, bounded recovery
+        try:
+            print(json.dumps(bench_webhook_storm()), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_storm",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
         # pipelined-executor depth sweep (graft-pipeline): overlap
         # efficiency at depth 1/2/4 with depth parity asserted — emits on
         # CPU too, so the record is always present in the trajectory
@@ -2162,6 +2412,20 @@ def main(argv=None) -> int:
         except (Exception, SystemExit) as exc:
             print(json.dumps({
                 "metric": "webhook_ingest",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # graft-storm smoke: the overload record at laptop scale (the
+        # same 5× overload factor and phase structure — fewer batches;
+        # the CI graft-storm job runs this record and gates on it)
+        try:
+            print(json.dumps(bench_webhook_storm(
+                num_pods=120, tenants=2, capacity_eps=2000,
+                baseline_batches=12, storm_batches=40,
+                recovery_batches=30, batch=150, churn_per_batch=6,
+                verbose=False)), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "webhook_storm",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
         # graft-evolve smoke: the online-learning record at laptop scale

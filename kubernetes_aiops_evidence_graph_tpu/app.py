@@ -11,20 +11,39 @@ kubernetes_aiops_evidence_graph_tpu.serve` works.
 from __future__ import annotations
 
 import asyncio
+import collections
+import sqlite3
 import threading
+from dataclasses import dataclass, field
 from typing import Any, Optional
 from uuid import UUID
 
 from .config import Settings, get_settings
 from .graph import GraphBuilder
+from .ingestion.admission import AdmissionController, CircuitBreaker
 from .ingestion.api import make_server
 from .ingestion.dedup import AlertDeduplicator, RateLimiter
 from .models import Incident, IncidentCreate
 from .observability import ALERTS_DEDUPLICATED, INCIDENTS_CREATED, configure, get_logger
+from .observability import metrics as obs_metrics
 from .storage import Database, DuplicateIncidentError
 from .workflow import IncidentWorker, WorkflowEngine
 
 log = get_logger("app")
+
+
+@dataclass
+class IngestBatchResult:
+    """Exact overload accounting for one columnar webhook batch: every
+    eligible row lands in exactly one of created / duplicates / shed /
+    sampled / spilled (the webhook_storm bench asserts the sum)."""
+
+    created: list[tuple[str, str]] = field(default_factory=list)
+    duplicates: int = 0
+    shed: int = 0                  # admission gate (token bucket dry)
+    sampled: int = 0               # storm-mode sampled persistence
+    spilled: int = 0               # persist breaker open -> spill journal
+    retry_after_s: float = 0.0
 
 
 class AiopsApp:
@@ -71,6 +90,28 @@ class AiopsApp:
                      endpoint=self.settings.otlp_endpoint)
         self.dedup = AlertDeduplicator(self.settings)
         self.rate_limiter = RateLimiter(self.settings)
+        # graft-storm: per-tenant token-bucket admission with severity
+        # shedding on the columnar webhook path (the legacy fixed-window
+        # limiter stays as the dict-path oracle's request gate), plus a
+        # circuit breaker around SQLite persist — open degrades ingest to
+        # the bounded spill journal instead of timing out every webhook.
+        # Chaos hooks (rca/faults.py ingest stages parse|dedup|persist|
+        # admit) thread through ``fault_injector``.
+        self.fault_injector: Any = None
+        self.admission: AdmissionController | None = None
+        if getattr(self.settings, "ingest_admission", False) and \
+                getattr(self.settings, "ingest_columnar", False):
+            self.admission = AdmissionController(self.settings)
+        self._persist_breaker = CircuitBreaker(
+            "persist",
+            failure_threshold=getattr(self.settings,
+                                      "breaker_failure_threshold", 5),
+            cooldown_s=getattr(self.settings, "breaker_cooldown_s", 2.0))
+        self._persist_spill: collections.deque = collections.deque(
+            maxlen=max(int(getattr(self.settings,
+                                   "persist_spill_cap", 4096)), 1))
+        self._spill_lock = threading.Lock()
+        self._storm_sample_counter = 0
         self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
                                      settings=self.settings, dedup=self.dedup)
         # graft-evolve (learn/): the online learning loop, attached to the
@@ -179,70 +220,191 @@ class AiopsApp:
             ALERTS_DEDUPLICATED.inc(reason="ttl")
             return None
         incident = Incident(**spec.model_dump())
-        try:
-            self.db.create_incident(incident)
-        except DuplicateIncidentError:
+        outcome = self._persist_incident(incident)
+        if outcome == "duplicate":
             ALERTS_DEDUPLICATED.inc(reason="storage")  # backstop (init-db.sql:27)
             return None
         self.dedup.register_fingerprint(spec.fingerprint)  # fixes defect 4
+        if outcome == "spilled":
+            # persist breaker open: the incident waits in the bounded
+            # spill journal and launches its workflow on replay — the
+            # webhook is acknowledged with its id, not timed out
+            return str(incident.id)
         INCIDENTS_CREATED.inc(severity=incident.severity.value)
+        self._submit_workflow(incident)
+        return str(incident.id)
+
+    def _submit_workflow(self, incident: Incident) -> None:
         if self._loop is not None:
             asyncio.run_coroutine_threadsafe(
                 self.worker.submit(incident), self._loop)
-        return str(incident.id)
 
-    def ingest_batch(self, cols) -> tuple[list[tuple[str, str]], int]:
-        """graft-intake: columnar batch twin of :meth:`ingest`.
+    # -- persist breaker + spill journal (graft-storm) --------------------
+
+    def _persist_incident(self, incident: Incident) -> str:
+        """One guarded DB insert: ``created`` | ``duplicate`` |
+        ``spilled``. A wedged SQLite (N consecutive failures) opens the
+        persist breaker; while open every incident costs one state check
+        and a bounded-deque append instead of a timeout, and the
+        half-open probe's first success replays the spill."""
+        inj = self.fault_injector
+        br = self._persist_breaker
+        if not br.allow():
+            self._spill(incident)
+            return "spilled"
+        try:
+            if inj is not None:
+                inj.at("persist")
+            self.db.create_incident(incident)
+        except DuplicateIncidentError:
+            br.record_success()
+            return "duplicate"
+        except (sqlite3.Error, OSError, RuntimeError) as exc:
+            br.record_failure()
+            log.error("persist_failed", error=str(exc),
+                      breaker=br.state)
+            self._spill(incident)
+            return "spilled"
+        br.record_success()
+        if self._persist_spill:
+            self._replay_spill()
+        return "created"
+
+    def _spill(self, incident: Incident) -> None:
+        with self._spill_lock:
+            if len(self._persist_spill) == self._persist_spill.maxlen:
+                obs_metrics.PERSIST_SPILL_DROPPED.inc()
+            self._persist_spill.append(incident)
+        obs_metrics.PERSIST_SPILLED.inc()
+
+    def _replay_spill(self) -> int:
+        """Drain the spill journal through the (now healthy) DB in spill
+        order; stops — leaving the rest spilled — on the first fresh
+        failure. Replayed incidents launch their workflows late rather
+        than never."""
+        replayed = 0
+        while True:
+            with self._spill_lock:
+                if not self._persist_spill:
+                    return replayed
+                incident = self._persist_spill.popleft()
+            try:
+                self.db.create_incident(incident)
+            except DuplicateIncidentError:
+                obs_metrics.ALERTS_DEDUPLICATED.inc(reason="storage")
+                continue
+            except (sqlite3.Error, OSError, RuntimeError) as exc:
+                self._persist_breaker.record_failure()
+                with self._spill_lock:
+                    self._persist_spill.appendleft(incident)
+                log.error("spill_replay_failed", error=str(exc))
+                return replayed
+            replayed += 1
+            obs_metrics.PERSIST_SPILL_REPLAYED.inc()
+            INCIDENTS_CREATED.inc(severity=incident.severity.value)
+            self._submit_workflow(incident)
+
+    def ingest_batch(self, cols) -> IngestBatchResult:
+        """graft-intake/graft-storm: columnar batch twin of
+        :meth:`ingest`, with the overload ladder applied in order.
 
         One vectorized dedup probe covers the whole batch (the hashed
-        ring answers every fingerprint in a handful of array compares),
-        intra-batch repeats collapse to their first occurrence, and only
-        the survivors — the rows that will actually become incidents —
-        pay pydantic spec construction and a DB insert. A duplicate storm
-        row costs a few array lanes instead of a model_dump.
-
-        Returns ``(created_ids, duplicates)``; malformed rows were
-        already masked (and counted) by the columnar normalizer."""
+        ring answers every fingerprint in a handful of array compares —
+        dedup runs FIRST so duplicates never charge the admission
+        budget), intra-batch repeats collapse to their first occurrence,
+        the admission gate sheds lowest-severity-first when the tenant's
+        token bucket runs dry (critical never sheds), storm mode samples
+        persistence of presumed re-arrivals, and only the remaining
+        survivors pay pydantic spec construction and a (breaker-guarded)
+        DB insert. Returns an :class:`IngestBatchResult` with exact
+        per-outcome accounting."""
         import numpy as np
 
-        from .observability import metrics as obs_metrics
-
+        res = IngestBatchResult()
+        inj = self.fault_injector
+        if inj is not None:
+            # "parse" chaos stage: the payload-decode boundary — a fault
+            # here rejects the whole batch (the webhook client retries),
+            # nothing was admitted or persisted
+            inj.at("parse")
         elig = np.flatnonzero(cols.eligible)
         if elig.size == 0:
-            return [], 0
+            return res
         fps = cols.fingerprint[elig]
-        dup = self.dedup.check_batch(fps)
+        try:
+            if inj is not None:
+                inj.at("dedup")
+            dup = self.dedup.check_batch(fps)
+        except RuntimeError as exc:
+            # fail open, like the scalar path: a broken dedup window must
+            # not drop alerts — the storage layer's UNIQUE-fingerprint
+            # backstop still suppresses duplicates, so admitted-event
+            # parity holds (chaos contract, tests/test_storm.py)
+            log.error("dedup_failed_open", error=str(exc))
+            dup = np.zeros(len(fps), bool)
         # intra-batch duplicates: the dict path registers the first
         # occurrence then TTL-hits the rest — keep-first via unique
         _, first = np.unique(fps, return_index=True)
         keep = np.zeros(len(fps), bool)
         keep[first] = True
         dup |= ~keep
-        duplicates = int(dup.sum())
-        if duplicates:
-            obs_metrics.ALERTS_DEDUPLICATED.inc(float(duplicates),
+        res.duplicates = int(dup.sum())
+        if res.duplicates:
+            obs_metrics.ALERTS_DEDUPLICATED.inc(float(res.duplicates),
                                                 reason="ttl")
-            obs_metrics.INGEST_DEDUP_HITS.inc(float(duplicates),
+            obs_metrics.INGEST_DEDUP_HITS.inc(float(res.duplicates),
                                               source=cols.source.value)
-        created: list[tuple[str, str]] = []   # (incident id, namespace)
-        registered: list[str] = []
-        for spec in cols.specs(elig[~dup]):
-            incident = Incident(**spec.model_dump())
+        # admission: dedup survivors charge the tenant's token bucket;
+        # shed rows answer 429 + Retry-After at the handler
+        admit = np.ones(len(fps), bool)
+        if self.admission is not None:
             try:
-                self.db.create_incident(incident)
-            except DuplicateIncidentError:
-                obs_metrics.ALERTS_DEDUPLICATED.inc(reason="storage")
-                duplicates += 1
+                admit, res.retry_after_s = self.admission.admit_batch(
+                    cols.namespace[elig], cols.severity_code[elig],
+                    chargeable=~dup)
+            except RuntimeError as exc:
+                # "admit" chaos stage / a broken gate fails OPEN: an
+                # admission outage must never drop alerts on its own
+                log.error("admission_failed_open", error=str(exc))
+                admit = np.ones(len(fps), bool)
+            res.shed = int((~admit & ~dup).sum())
+        # storm-mode sampled persistence: fresh non-critical rows are
+        # overwhelmingly re-arrivals whose ring entry was evicted —
+        # persist 1-in-N, register the rest back into the ring
+        survivors = ~dup & admit
+        sampled_fps: list[str] = []
+        if (self.admission is not None and self.admission.storm.active):
+            every = int(getattr(self.settings, "storm_sample_every", 0))
+            if every > 1:
+                sev = cols.severity_code[elig]
+                ns = cols.namespace[elig]
+                for i in np.flatnonzero(survivors & (sev > 0)):
+                    self._storm_sample_counter += 1
+                    if self._storm_sample_counter % every:
+                        survivors[i] = False
+                        sampled_fps.append(str(fps[i]))
+                        obs_metrics.STORM_SAMPLED_ROWS.inc(
+                            tenant=str(ns[i]))
+                res.sampled = len(sampled_fps)
+        registered: list[str] = []
+        for spec in cols.specs(elig[survivors]):
+            incident = Incident(**spec.model_dump())
+            outcome = self._persist_incident(incident)
+            if outcome == "duplicate":
+                res.duplicates += 1
                 continue
             registered.append(spec.fingerprint)
+            if outcome == "spilled":
+                res.spilled += 1
+                continue
             INCIDENTS_CREATED.inc(severity=incident.severity.value)
-            if self._loop is not None:
-                asyncio.run_coroutine_threadsafe(
-                    self.worker.submit(incident), self._loop)
-            created.append((str(incident.id), incident.namespace))
-        if registered:
-            self.dedup.register_batch(registered)
-        return created, duplicates
+            self._submit_workflow(incident)
+            res.created.append((str(incident.id), incident.namespace))
+        if registered or sampled_fps:
+            # sampled rows register too: their repeats must dedup, and
+            # the row they stand in for will exist once a sample lands
+            self.dedup.register_batch(registered + sampled_fps)
+        return res
 
     def workflow_status(self, incident_id: str | UUID) -> dict:
         return self.worker.engine.status(f"incident-{incident_id}")

@@ -53,6 +53,60 @@ class Settings:
     # probe neighborhood evicts the oldest-expiry entry (counted in
     # aiops_ingest_dedup_evictions_total).
     ingest_dedup_window: int = 32768
+    # graft-storm: overload-robust serving. The columnar webhook path is
+    # gated by a per-tenant token-bucket admission controller
+    # (ingestion/admission.py) with severity-weighted shedding: when a
+    # tenant's sustained inflow of dedup SURVIVORS exceeds the drain rate
+    # below, rows shed lowest-severity-first (critical is NEVER shed) and
+    # the response carries 429 + Retry-After derived from bucket refill.
+    # Duplicates ride free — the ring absorbs them before the gate, so a
+    # duplicate-heavy storm cannot shed the critical needle. False
+    # restores the legacy fixed-window per-client RateLimiter alone (the
+    # dict-path oracle keeps it either way).
+    ingest_admission: bool = True
+    # sustained per-tenant survivor drain rate (tokens/s) and burst
+    # capacity of the admission bucket. The defaults are sized WAY above
+    # the interactive-test envelope and at ~the measured CPU ingest
+    # capacity per tenant, so steady state never sheds.
+    admission_rate_per_sec: float = 2000.0
+    admission_burst: float = 4000.0
+    # storm mode: hysteresis-gated degraded tier. Pressure = admission
+    # shed-ratio EWMA above the enter ratio, dedup-ring eviction rate or
+    # absorb busy-yield rate above their thresholds; sustained pressure
+    # for storm_dwell_s enters, sustained calm below the exit ratio for
+    # the same dwell exits. Transitions are counted, stamped into flight
+    # records, and every tick dispatched during storm carries a "storm"
+    # flag in its TickSpan.
+    storm_enter_shed_ratio: float = 0.25
+    storm_exit_shed_ratio: float = 0.02
+    storm_dwell_s: float = 1.0
+    storm_eviction_rate_per_s: float = 500.0
+    storm_busy_rate_per_s: float = 50.0
+    # storm-mode sampled persistence: under ring-eviction pressure a
+    # fresh-looking NON-critical row is overwhelmingly a re-arrival whose
+    # ring entry was evicted — persist 1-in-N of them (the rest register
+    # back into the ring so repeats dedup) instead of paying a DB insert
+    # per re-arrival. Critical rows always persist. 0 disables sampling.
+    storm_sample_every: int = 8
+    # absorb() busy-yield backlog bound: a non-blocking absorb that finds
+    # the serving state held normally yields (the contending boundary's
+    # own sync drains the journal) — but past this many unsynced store-
+    # journal records it escalates to a SYNCHRONOUS drain instead, so a
+    # storm cannot grow the journal unboundedly behind a busy serving
+    # loop (counted in aiops_serve_absorb_sync_drains_total).
+    ingest_max_journal_backlog: int = 8192
+    # circuit breakers (ingestion/admission.CircuitBreaker) around the
+    # two blocking downstreams: SQLite persist (app.ingest_batch — open
+    # degrades ingest to the bounded spill journal instead of timing out
+    # every webhook) and device dispatch (rca/shield.py — open degrades
+    # tick()/absorb() to journal-only, the store journal holds the deltas
+    # until the half-open probe recovers). N consecutive failures open;
+    # after the cooldown one half-open probe closes or re-opens.
+    breaker_failure_threshold: int = 5
+    breaker_cooldown_s: float = 2.0
+    # bounded spill journal for persist-breaker-open incidents (replayed
+    # on breaker close; overflow drops oldest, counted)
+    persist_spill_cap: int = 4096
 
     # --- storage ---
     db_path: str = "kaeg.sqlite"                   # replaces Postgres DSN

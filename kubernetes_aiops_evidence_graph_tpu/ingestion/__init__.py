@@ -1,3 +1,4 @@
+from .admission import AdmissionController, CircuitBreaker, StormMode
 from .columnar import (
     ColumnarAlerts,
     normalize_alertmanager_batch,
@@ -11,4 +12,5 @@ __all__ = [
     "AlertNormalizer", "AlertDeduplicator", "RateLimiter", "TTLSet",
     "FingerprintRing", "ColumnarAlerts", "normalize_alertmanager_batch",
     "normalize_grafana_batch", "normalize_prometheus_batch",
+    "AdmissionController", "CircuitBreaker", "StormMode",
 ]

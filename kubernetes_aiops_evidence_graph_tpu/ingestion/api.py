@@ -54,11 +54,14 @@ class ApiHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence default stderr spam
         pass
 
-    def _json(self, status: int, payload: Any) -> None:
+    def _json(self, status: int, payload: Any,
+              headers: "dict[str, str] | None" = None) -> None:
         body = json.dumps(payload, default=str).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -130,36 +133,45 @@ class ApiHandler(BaseHTTPRequestHandler):
     def _columnar_webhook(self, source: str, normalize, t_parse: float):
         """Shared columnar handler tail: normalize → batch ingest →
         per-stage aiops_ingest_* accounting. ``t_parse`` is the JSON
-        parse wall already spent in ``_body``."""
+        parse wall already spent in ``_body``. Returns the
+        :class:`~..app.IngestBatchResult` — the caller renders the
+        response (200 with shed accounting, or a full-shed 429 with
+        Retry-After)."""
         from ..observability.metrics import (
             INGEST_BATCH_FILL, INGEST_MALFORMED_ROWS, INGEST_ROWS,
             INGEST_ROWS_PER_SEC, INGEST_STAGE_SECONDS)
         t1 = time.perf_counter()
         cols = normalize()
         t2 = time.perf_counter()
-        created, duplicates = self.app.ingest_batch(cols)
+        res = self.app.ingest_batch(cols)
         t3 = time.perf_counter()
         n = len(cols)
         ALERTS_RECEIVED.inc(float(n), source=source)
-        for iid, ns in created:
+        for iid, ns in res.created:
             SCOPE.webhook_received(iid, tenant=ns or "default")
         INGEST_STAGE_SECONDS.observe(t_parse, stage="parse", source=source)
         INGEST_STAGE_SECONDS.observe(t2 - t1, stage="normalize",
                                      source=source)
-        # dedup probe + spec construction + DB insert ride ingest_batch;
-        # the probe is a handful of vectorized compares, so the window
-        # is reported as one "persist" stage with dedup hits counted
-        # separately (aiops_ingest_dedup_hits_total)
+        # dedup probe + admission gate + spec construction + DB insert
+        # ride ingest_batch; the probe/gate are a handful of vectorized
+        # compares, so the window is reported as one "persist" stage with
+        # dedup hits / sheds counted separately
         INGEST_STAGE_SECONDS.observe(t3 - t2, stage="persist",
                                      source=source)
         if n:
             eligible = int(cols.eligible.sum())
-            INGEST_ROWS.inc(float(len(created)), source=source,
+            INGEST_ROWS.inc(float(len(res.created)), source=source,
                             outcome="created")
-            INGEST_ROWS.inc(float(duplicates), source=source,
+            INGEST_ROWS.inc(float(res.duplicates), source=source,
                             outcome="duplicate")
             INGEST_ROWS.inc(float(n - cols.malformed - eligible),
                             source=source, outcome="not_firing")
+            for outcome, count in (("shed", res.shed),
+                                   ("storm_sampled", res.sampled),
+                                   ("spilled", res.spilled)):
+                if count:
+                    INGEST_ROWS.inc(float(count), source=source,
+                                    outcome=outcome)
             if cols.malformed:
                 INGEST_ROWS.inc(float(cols.malformed), source=source,
                                 outcome="malformed")
@@ -169,15 +181,50 @@ class ApiHandler(BaseHTTPRequestHandler):
             wall = t_parse + (t3 - t1)
             if wall > 0:
                 INGEST_ROWS_PER_SEC.set(n / wall, source=source)
-        return [iid for iid, _ns in created], duplicates
+        return res
+
+    def _rate_limited(self) -> None:
+        """Legacy fixed-window 429 — now with Retry-After (time to the
+        next window), the header the reference limiter never sent."""
+        retry = self.app.rate_limiter.retry_after_s()
+        self._json(429, {"error": "rate limit exceeded",
+                         "retry_after_s": round(retry, 1)},
+                   headers={"Retry-After": str(max(int(retry + 0.5), 1))})
+
+    def _columnar_response(self, res, endpoint: str, t0: float) -> None:
+        """Render one columnar ingest result. A batch whose every
+        admission-eligible row was shed answers 429 + Retry-After
+        (token-bucket refill time); partial sheds answer 200 with exact
+        accounting plus the advisory Retry-After header."""
+        WEBHOOK_LATENCY.observe(time.perf_counter() - t0,
+                                endpoint=endpoint)
+        headers = {}
+        if res.retry_after_s > 0:
+            headers["Retry-After"] = str(max(int(res.retry_after_s + 0.5),
+                                             1))
+        body = {"created": [iid for iid, _ns in res.created],
+                "duplicates": res.duplicates}
+        for k in ("shed", "sampled", "spilled"):
+            if getattr(res, k):
+                body[k] = getattr(res, k)
+        if res.shed and not res.created and not res.duplicates \
+                and not res.sampled:
+            self._json(429, {"error": "admission shed", **body},
+                       headers=headers)
+            return
+        self._json(200, body, headers=headers)
 
     @route("POST", "/api/v1/webhooks/alertmanager")
     def webhook_alertmanager(self):
         from .normalizer import AlertNormalizer
         t0 = time.perf_counter()
         client = self.client_address[0] if self.client_address else "unknown"
-        if not self.app.rate_limiter.check_rate_limit(client):
-            self._json(429, {"error": "rate limit exceeded"})
+        # graft-storm: the columnar path is gated by the severity-aware
+        # per-tenant admission controller inside ingest_batch — the
+        # per-client fixed window only guards the dict-path oracle
+        if getattr(self.app, "admission", None) is None and \
+                not self.app.rate_limiter.check_rate_limit(client):
+            self._rate_limited()
             return
         payload = self._body()
         t_parse = time.perf_counter() - t0
@@ -192,12 +239,10 @@ class ApiHandler(BaseHTTPRequestHandler):
         if getattr(self.app.settings, "ingest_columnar", False):
             from .columnar import normalize_alertmanager_batch
             with TRACER.span("webhook.alertmanager", alerts=len(alerts)):
-                created, duplicates = self._columnar_webhook(
+                res = self._columnar_webhook(
                     "alertmanager",
                     lambda: normalize_alertmanager_batch(alerts), t_parse)
-            WEBHOOK_LATENCY.observe(time.perf_counter() - t0,
-                                    endpoint="alertmanager")
-            self._json(200, {"created": created, "duplicates": duplicates})
+            self._columnar_response(res, "alertmanager", t0)
             return
         if any(not isinstance(a, dict) for a in alerts):
             self._json(400, {"error": "alerts must be a list of alert objects"})
@@ -224,20 +269,19 @@ class ApiHandler(BaseHTTPRequestHandler):
         from .normalizer import AlertNormalizer
         t0 = time.perf_counter()
         client = self.client_address[0] if self.client_address else "unknown"
-        if not self.app.rate_limiter.check_rate_limit(client):
-            self._json(429, {"error": "rate limit exceeded"})
+        if getattr(self.app, "admission", None) is None and \
+                not self.app.rate_limiter.check_rate_limit(client):
+            self._rate_limited()
             return
         payload = self._body()
         t_parse = time.perf_counter() - t0
         if getattr(self.app.settings, "ingest_columnar", False):
             from .columnar import normalize_grafana_batch
             with TRACER.span("webhook.grafana"):
-                created, duplicates = self._columnar_webhook(
+                res = self._columnar_webhook(
                     "grafana",
                     lambda: normalize_grafana_batch(payload), t_parse)
-            WEBHOOK_LATENCY.observe(time.perf_counter() - t0,
-                                    endpoint="grafana")
-            self._json(200, {"created": created, "duplicates": duplicates})
+            self._columnar_response(res, "grafana", t0)
             return
         created, duplicates = [], 0
         with TRACER.span("webhook.grafana"):

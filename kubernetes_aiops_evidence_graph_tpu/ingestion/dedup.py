@@ -248,12 +248,25 @@ class AlertDeduplicator:
 
 
 class RateLimiter:
-    """Fixed one-minute windows per client key (deduplicator.py:147-177)."""
+    """Fixed one-minute windows per client key (deduplicator.py:147-177).
+
+    graft-storm fixed its unbounded-memory defect: ``_windows`` grew one
+    entry per distinct client key FOREVER (a storm from many source IPs
+    = a memory leak). Entries from previous windows are now pruned when
+    the limiter first observes a new window — the sweep runs at most
+    once per window roll, so the steady-state cost is unchanged. The
+    columnar webhook path replaces this limiter entirely with the
+    severity-aware per-tenant token-bucket gate
+    (ingestion/admission.AdmissionController); this stays as the
+    dict-path oracle's request gate, now with a ``retry_after_s`` so
+    429 responses can carry Retry-After.
+    """
 
     def __init__(self, settings: Settings | None = None, clock=time.monotonic) -> None:
         self.settings = settings or get_settings()
         self._clock = clock
         self._windows: dict[str, tuple[int, int]] = {}  # key -> (window, count)
+        self._cur_window = -1
         self._lock = threading.Lock()
 
     def check_rate_limit(self, client: str) -> bool:
@@ -261,9 +274,25 @@ class RateLimiter:
         window = int(self._clock() // 60)
         limit = self.settings.webhook_rate_limit_per_minute
         with self._lock:
+            if window != self._cur_window:
+                # window rolled: every entry stamped with an older window
+                # is dead weight — prune them all in one sweep
+                self._windows = {k: v for k, v in self._windows.items()
+                                 if v[0] == window}
+                self._cur_window = window
             w, count = self._windows.get(client, (window, 0))
             if w != window:
                 w, count = window, 0
             count += 1
             self._windows[client] = (w, count)
             return count <= limit
+
+    def retry_after_s(self) -> float:
+        """Seconds until the current fixed window rolls — the
+        Retry-After a 429 from this limiter carries."""
+        now = self._clock()
+        return max(60.0 - (now % 60.0), 0.0)
+
+    def tracked_clients(self) -> int:
+        with self._lock:
+            return len(self._windows)

@@ -53,9 +53,13 @@ class OnlineLearner:
     ``db`` is the shared durable store the labels come from."""
 
     def __init__(self, db, targets, settings: "Settings | None" = None,
-                 now_s: "float | None" = None) -> None:
+                 now_s: "float | None" = None, injector=None) -> None:
         self.settings = settings or get_settings()
         self.db = db
+        # graft-storm chaos seam (rca/faults.py LEARN_STAGES): the
+        # harvest/swap hooks prove a faulted learn cycle is CONTAINED —
+        # serving params and generation untouched, the loop survives
+        self.injector = injector
         # stable order — the atomic swap's deadlock-freedom rests on
         # every swapper acquiring serve_locks in one canonical order
         self.targets = list(targets if isinstance(targets, (list, tuple))
@@ -124,6 +128,8 @@ class OnlineLearner:
         after closure) replay from their persisted evidence instead
         (build_replay_episode). Returns the number of NEW
         (non-duplicate) episodes absorbed."""
+        if self.injector is not None:
+            self.injector.at("harvest")
         labels = harvest_labels(
             self.db, weak=bool(self.settings.learn_weak_labels),
             weak_confidence=float(self.settings.learn_weak_confidence))
@@ -206,6 +212,10 @@ class OnlineLearner:
     def swap(self, params, source: str = "finetune") -> int:
         """Atomic hot swap into every target (see module docstring);
         arms the post-swap health watch."""
+        if self.injector is not None:
+            # fires BEFORE any target swaps: a faulted swap leaves every
+            # target on the old generation (atomicity = all-or-nothing)
+            self.injector.at("swap")
         from ..rca.surge import swap_tenants_atomically
         gen = swap_tenants_atomically(self.targets, params, source=source)
         self.swaps += 1

@@ -243,6 +243,65 @@ INGEST_DEDUP_OCCUPANCY = REGISTRY.gauge(
     "Live (unexpired) fingerprint slots resident in the hashed dedup "
     "ring")
 
+# graft-storm instrumentation (ingestion/admission.py + the overload
+# paths in app.py / rca/streaming.py / rca/shield.py): the admission
+# gate, storm-mode tier, circuit breakers, and the absorb busy/backlog
+# escalation — the overload story must be exactly accountable (the
+# webhook_storm bench asserts admitted + shed + sampled sums match).
+ADMISSION_ADMITTED = REGISTRY.counter(
+    "aiops_admission_admitted_total",
+    "Webhook rows admitted by the per-tenant token-bucket gate, by "
+    "tenant and severity")
+ADMISSION_SHED = REGISTRY.counter(
+    "aiops_admission_shed_total",
+    "Webhook rows shed by the admission gate (token bucket exhausted — "
+    "lowest severity first, critical NEVER), by tenant and severity")
+ADMISSION_TOKENS = REGISTRY.gauge(
+    "aiops_admission_tokens",
+    "Admission token-bucket level after the most recent batch, by "
+    "tenant (negative = critical-only overdraft, bounded at -burst)")
+STORM_MODE = REGISTRY.gauge(
+    "aiops_storm_mode",
+    "1 while the ingest path is in the hysteresis-gated storm tier "
+    "(degraded: pre-shed info, sampled persistence, harder coalescing)")
+STORM_TRANSITIONS = REGISTRY.counter(
+    "aiops_storm_transitions_total",
+    "Storm-mode tier transitions, by direction (enter | exit)")
+STORM_SAMPLED_ROWS = REGISTRY.counter(
+    "aiops_storm_sampled_rows_total",
+    "Non-critical fresh rows suppressed by storm-mode sampled "
+    "persistence (registered back into the dedup ring as presumed "
+    "re-arrivals past an evicting window), by tenant")
+BREAKER_STATE = REGISTRY.gauge(
+    "aiops_breaker_state",
+    "Circuit-breaker state by breaker name: 0 closed, 1 half_open, "
+    "2 open")
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "aiops_breaker_transitions_total",
+    "Circuit-breaker state transitions, by breaker name and new state")
+PERSIST_SPILLED = REGISTRY.counter(
+    "aiops_persist_spilled_total",
+    "Incidents diverted to the bounded spill journal while the SQLite "
+    "persist breaker was open (replayed on breaker close)")
+PERSIST_SPILL_REPLAYED = REGISTRY.counter(
+    "aiops_persist_spill_replayed_total",
+    "Spilled incidents persisted by the post-recovery replay")
+PERSIST_SPILL_DROPPED = REGISTRY.counter(
+    "aiops_persist_spill_dropped_total",
+    "Spilled incidents dropped because the bounded spill journal "
+    "overflowed (oldest-first) — the accountable data-loss path of a "
+    "wedged DB outlasting the spill capacity")
+SERVE_ABSORB_BUSY = REGISTRY.counter(
+    "aiops_serve_absorb_busy_total",
+    "Non-blocking absorb() calls that yielded busy because a caller-"
+    "boundary tick or fetch held the serving state (their deltas stay "
+    "in the store journal for the contending boundary's sync)")
+SERVE_ABSORB_SYNC_DRAINS = REGISTRY.counter(
+    "aiops_serve_absorb_sync_drains_total",
+    "absorb() busy yields that escalated to a synchronous journal drain "
+    "because the unsynced store-journal backlog crossed "
+    "ingest_max_journal_backlog")
+
 # Serving-pipeline instrumentation (graft-pipeline, rca/streaming.py):
 # the double-buffered executor that overlaps host delta staging with
 # device ticks and defers device_get to the caller boundary.

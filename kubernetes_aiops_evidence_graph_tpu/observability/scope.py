@@ -266,6 +266,15 @@ def _default_flight_dir() -> str:
 
 FLIGHT_RECORDER = FlightRecorder()
 
+# graft-storm: process-wide storm-mode mirror, written ONLY by
+# ingestion/admission.StormMode on its hysteresis transitions (which also
+# interleave a note_event into the flight ring). A plain dict read keeps
+# the tick hot path allocation- and import-free: TickScope.begin stamps a
+# "storm" flag onto every tick dispatched while the ingest tier is
+# degraded, and rca/streaming.py reads it for the harder coalescing
+# bound — serving code never imports the ingestion layer.
+STORM_FLAG = {"active": False}
+
 
 # -- sharded routing visibility (parallel/sharded_streaming.py hook) --------
 
@@ -433,9 +442,12 @@ class TickScope:
             return None
         self._serial += 1
         qw, self._pending_queue_wait = self._pending_queue_wait, 0.0
-        return TickSpan(self._serial, self.backend,
+        span = TickSpan(self._serial, self.backend,
                         int(getattr(scorer, "pipeline_depth", 1)),
                         str(getattr(scorer, "_scope_tier", "steady")), qw)
+        if STORM_FLAG["active"]:
+            span.flag("storm")
+        return span
 
     def note_queue_wait(self, seconds: float) -> None:
         """A pipeline-full stall (tick_async) or pre-dispatch drain

@@ -28,6 +28,24 @@ Stages (where the hooks fire):
 * ``stall``          — the tick completes but only after sleeping past the
                        watchdog timeout (fires at the ``execute`` hook)
 
+graft-storm widened the harness past the tick path — the ingest and
+learner paths previously had ZERO fault coverage:
+
+* ``parse``          — the webhook payload-decode boundary
+                       (app.ingest_batch entry): the batch is rejected,
+                       nothing admitted/persisted, the client retries
+* ``dedup``          — the batch dedup probe: MUST fail open (alerts are
+                       never dropped by a broken window; the storage
+                       UNIQUE-fingerprint backstop preserves parity)
+* ``persist``        — the SQLite insert: failures walk the persist
+                       circuit breaker (open → bounded spill journal →
+                       half-open probe → replay)
+* ``admit``          — the admission gate: MUST fail open (a broken gate
+                       never drops alerts on its own)
+* ``harvest`` / ``swap`` — the online-learning loop (learn/loop.py): a
+                       faulted cycle is contained — serving params and
+                       generation are untouched, the loop survives
+
 Faults address the Nth *visit* of their stage and can repeat for several
 consecutive visits (``repeats``) to force the shield past bounded retry
 into the deeper degradation tiers.
@@ -44,8 +62,12 @@ from ..observability import get_logger
 
 log = get_logger("shield.faults")
 
-STAGES = ("staging", "dispatch", "pack", "execute", "fetch",
-          "journal_append", "snapshot_write", "delta_values")
+TICK_STAGES = ("staging", "dispatch", "pack", "execute", "fetch",
+               "journal_append", "snapshot_write", "delta_values")
+# graft-storm: the previously-uncovered halves of the pipeline
+INGEST_STAGES = ("parse", "dedup", "persist", "admit")
+LEARN_STAGES = ("harvest", "swap")
+STAGES = TICK_STAGES + INGEST_STAGES + LEARN_STAGES
 
 # value-corruption stages return poisoned data instead of raising
 _POISON_STAGES = frozenset({"delta_values"})

@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import Settings, get_settings
+from ..ingestion.admission import CircuitBreaker
 from ..observability import get_logger
 from ..observability import metrics as obs_metrics
 from ..observability import scope as obs_scope
@@ -193,6 +194,22 @@ class ShieldedScorer:
         self._snap_thread: "threading.Thread | None" = None
         self.last_capture_seconds = 0.0
         self.last_snapshot_seconds = 0.0
+        # graft-storm: circuit breaker around device dispatch. Bounded
+        # consecutive dispatch-class failures open it; while open the
+        # NON-verdict submission paths (tick()/absorb()) skip the device
+        # entirely — the deltas wait in the store journal, whose cursor
+        # the skipped drain never advanced, so crash recovery stays
+        # sound — and the half-open probe after the cooldown re-walks
+        # the full path once. The verdict boundary (rescore/serve) never
+        # consults it: correctness beats fail-fast where a caller is
+        # actually waiting on a verdict.
+        self.breaker = CircuitBreaker(
+            "dispatch",
+            failure_threshold=getattr(self.settings,
+                                      "breaker_failure_threshold", 5),
+            cooldown_s=getattr(self.settings, "breaker_cooldown_s", 2.0))
+        self.breaker_skips = 0
+        self._last_run_failures = 0
 
     # -- delegation --------------------------------------------------------
 
@@ -224,9 +241,41 @@ class ShieldedScorer:
             return self._run_with_recovery(self._tick_rescore)
 
     def tick(self) -> dict:
-        """Protected pipelined submission (scorer.tick_async)."""
+        """Protected pipelined submission (scorer.tick_async), behind the
+        dispatch circuit breaker: while open, the submission is skipped
+        outright — one state check per webhook instead of a ladder walk
+        per webhook — and the deltas stay in the store journal for the
+        half-open probe (or any verdict-boundary call) to drain."""
         with self._lock:
-            return self._run_with_recovery(self._tick_async)
+            if not self.breaker.allow():
+                return self._breaker_skip()
+            try:
+                out = self._run_with_recovery(self._tick_async)
+            except (RuntimeError, OSError) as exc:
+                if self.breaker.state == "open":
+                    # the ladder exhausted AND the breaker just opened:
+                    # the ingest path degrades to journal-only instead
+                    # of surfacing a timeout per webhook
+                    log.error("tick_degraded_breaker_open",
+                              error=str(exc))
+                    return {"dispatched": False, "breaker_open": True,
+                            "error": str(exc)}
+                raise
+            if self._last_run_failures == 0:
+                # a clean pass closes a half-open probe / resets the
+                # consecutive-failure count; a pass that only succeeded
+                # through recovery leaves the breaker where it was
+                self.breaker.record_success()
+            return out
+
+    def _breaker_skip(self) -> dict:
+        self.breaker_skips += 1
+        backlog = 0
+        fn = getattr(self.scorer, "_journal_backlog", None)
+        if fn is not None:
+            backlog = int(fn())
+        return {"dispatched": False, "breaker_open": True,
+                "backlog": backlog}
 
     def absorb(self) -> dict:
         """Protected webhook-burst ingestion (graft-surge): WAL-journal +
@@ -399,7 +448,9 @@ class ShieldedScorer:
                 self._escalate(exc, state)
                 continue
             self._watchdog(time.perf_counter() - t0)
-            if state["failures"] and self.tier != "rules_fallback":
+            self._last_run_failures = state["failures"]
+            if state["failures"] and self.tier not in ("rules_fallback",
+                                                       "breaker_open"):
                 self.tier = "steady"
                 self.scorer._scope_tier = "steady"
             return out
@@ -412,6 +463,14 @@ class ShieldedScorer:
             raise exc
         stage = getattr(exc, "stage", "")
         suspect = stage not in _RETRIABLE_STAGES
+        if stage in ("dispatch", "execute", "pack", ""):
+            # dispatch-class (or unattributed device-path) failure feeds
+            # the circuit breaker; crossing the consecutive-failure
+            # threshold opens it and becomes a visible shield tier
+            was_open = self.breaker.state == "open"
+            self.breaker.record_failure()
+            if self.breaker.state == "open" and not was_open:
+                self._transition("breaker_open")
         log.warning("guarded_tick_failed", stage=stage or "unknown",
                     error=str(exc), failures=state["failures"],
                     suspect=suspect)
@@ -726,6 +785,8 @@ class ShieldedScorer:
             "journal_batches": self.journal.appended_batches,
             "journal_bytes": self.journal.appended_bytes,
             "torn_truncations": self.journal.torn_truncations,
+            "breaker": self.breaker.stats(),
+            "breaker_skips": self.breaker_skips,
         }
 
     def close(self) -> None:

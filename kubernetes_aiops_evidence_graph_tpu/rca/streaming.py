@@ -395,6 +395,14 @@ class StreamingScorer:
         self.coalesced_ticks = 0
         self.deferred_fetches = 0
         self.stall_seconds = 0.0
+        # graft-storm: absorb() busy-yield accounting + the backlog bound
+        # past which a yield escalates to a synchronous drain; storm-mode
+        # ticks also coalesce harder (see _tick_async_locked)
+        self.absorb_busy = 0
+        self.absorb_sync_drains = 0
+        self.storm_coalesced_ticks = 0
+        self._max_journal_backlog = max(int(getattr(
+            self.settings, "ingest_max_journal_backlog", 8192)), 1)
         # graft-scope: per-tick telemetry front-end. The hot path pays one
         # attribute read per boundary when disabled; enabled it records
         # host-monotonic stage marks only — no device syncs the serving
@@ -1848,18 +1856,59 @@ class StreamingScorer:
         caller-boundary tick or fetch holds the serving state, absorb
         yields immediately (``busy``) instead of serializing webhook
         ingest behind device readbacks — the deltas stay in the journal
-        and the contending boundary's own sync drains them."""
+        and the contending boundary's own sync drains them.
+
+        graft-storm bounds the backlog that yielding can build: every
+        busy yield is counted (``aiops_serve_absorb_busy_total``), and
+        once the unsynced store-journal backlog crosses
+        ``settings.ingest_max_journal_backlog`` the yield escalates to a
+        SYNCHRONOUS drain (blocking acquire, counted) — under a storm a
+        busy serving loop can defer ingest, never let it grow without
+        bound toward the store journal's truncation horizon."""
         if not self.serve_lock.acquire(blocking=False):
-            return {"dispatched": False, "coalesced": False, "busy": True}
+            self.absorb_busy += 1
+            obs_metrics.SERVE_ABSORB_BUSY.inc()
+            backlog = self._journal_backlog()
+            if backlog <= self._max_journal_backlog:
+                return {"dispatched": False, "coalesced": False,
+                        "busy": True, "backlog": backlog}
+            self.absorb_sync_drains += 1
+            obs_metrics.SERVE_ABSORB_SYNC_DRAINS.inc()
+            self.serve_lock.acquire()
         try:
             self.sync()
             return self._tick_async_locked()
         finally:
             self.serve_lock.release()
 
+    def _journal_backlog(self) -> int:
+        """Store-journal records not yet drained into the resident state
+        (the backlog a busy-yielding absorb is deferring)."""
+        return max(int(self.store.journal_seq) - int(self._synced_seq), 0)
+
     def _tick_async_locked(self) -> dict:
         """tick_async body; the caller holds ``serve_lock``."""
         self._retire_ready()
+        # graft-storm degraded tier: while the ingest layer is in storm
+        # mode, coalesce whenever ANY tick is already in flight (not just
+        # on a full queue) — storm ticks merge toward the delta-ladder
+        # top, one larger dispatch instead of many small ones. Host-side
+        # only and bit-parity-preserving: coalescing is the same merge
+        # the full-queue path already proves identical, and the caller
+        # boundary (rescore/serve) still drains everything.
+        if (obs_scope.STORM_FLAG["active"] and self._inflight
+                and len(self._inflight) < self.pipeline_depth):
+            pending = self._pending_delta_count()
+            if pending < self._coalesce_bound:
+                self.coalesced_ticks += 1
+                self.storm_coalesced_ticks += 1
+                self._scope_coalesced_since += 1
+                self.scope.note_coalesced(pending)
+                obs_metrics.SERVE_COALESCED_TICKS.inc()
+                obs_metrics.SERVE_COALESCED_TICK_SIZE.set(float(pending))
+                return {"dispatched": False, "coalesced": True,
+                        "storm": True, "inflight": len(self._inflight),
+                        "pending": pending}
         if len(self._inflight) >= self.pipeline_depth:
             pending = self._pending_delta_count()
             if pending < self._coalesce_bound:

@@ -503,6 +503,14 @@ class MultiTenantScorer(StreamingScorer):
         self._note_queue_depths()
         return totals
 
+    def _journal_backlog(self) -> int:
+        """graft-storm: the pack's undrained backlog is the SUM over
+        tenant journals (quarantined regions excluded — their journal
+        deliberately stops draining until the heal)."""
+        return sum(
+            max(int(reg.store.journal_seq) - int(reg.synced_seq), 0)
+            for reg in self._regions_order if not reg.quarantined)
+
     def _note_queue_depths(self) -> None:
         counts = {reg.name: 0 for reg in self._regions_order}
         for row in self._pending_feat:

@@ -197,6 +197,90 @@ def test_ring_eviction_counter_and_occupancy():
     assert ring.occupancy() == 0               # TTL expiry empties it
 
 
+def test_ring_full_occupancy_eviction_storm_stays_exact():
+    """graft-storm satellite: at 100% occupancy, with TTL expiry RACING
+    evict-oldest (some slots expire mid-storm, others are evicted live),
+    the ring's slot state, occupancy gauge, and eviction counter must
+    stay EXACT — pinned against an independent pure-Python shadow of the
+    placement algorithm, and against the TTLSet oracle for every key the
+    ring still holds."""
+    cap, probes = 64, 4
+    clock = [0.0]
+    ring = FingerprintRing(capacity=cap, probes=probes,
+                           clock=lambda: clock[0])
+    assert ring.capacity == cap
+
+    # the shadow: an independent re-implementation of the placement
+    # contract (refresh live slot -> first free/expired slot -> evict
+    # the neighborhood's oldest expiry, counted)
+    sh_hash = [0] * cap
+    sh_exp = [0.0] * cap
+    shadow_evictions = [0]
+
+    def shadow_add(h: int, exp: float, now: float) -> None:
+        base = h & (cap - 1)
+        free, oldest_slot, oldest_exp = -1, -1, np.inf
+        for p in range(probes):
+            slot = (base + p) & (cap - 1)
+            if sh_hash[slot] == h:
+                sh_exp[slot] = exp
+                return
+            if free < 0 and (sh_hash[slot] == 0 or sh_exp[slot] < now):
+                free = slot
+            if sh_exp[slot] < oldest_exp:
+                oldest_slot, oldest_exp = slot, sh_exp[slot]
+        if free < 0:
+            free = oldest_slot
+            shadow_evictions[0] += 1
+        sh_hash[free] = h
+        sh_exp[free] = exp
+
+    def shadow_live(now: float) -> int:
+        return sum(1 for s in range(cap)
+                   if sh_hash[s] != 0 and sh_exp[s] >= now)
+
+    oracle = TTLSet(clock=lambda: clock[0])
+    rng = np.random.default_rng(20260805)
+    universe = [bytes(rng.bytes(16)).hex() for _ in range(400)]
+
+    def drive(fp: str, ttl: float) -> None:
+        ring.add(fp, ttl)
+        oracle.add(fp, ttl)
+        shadow_add(int(ring._h(fp)), clock[0] + ttl, clock[0])
+
+    # phase 1: fill to (and past) full occupancy with mixed TTLs
+    for i, fp in enumerate(universe[:160]):
+        clock[0] = i * 0.1
+        drive(fp, 50.0 + (i % 5) * 100.0)
+    # phase 2: advance so a tranche TTL-expires mid-storm, then storm
+    # more adds into the full table — expiry and eviction now race for
+    # the same slots
+    clock[0] = 80.0
+    for i, fp in enumerate(universe[160:]):
+        clock[0] = 80.0 + i * 0.05
+        drive(fp, 30.0 + (i % 3) * 60.0)
+
+    # exactness: slot-for-slot equality with the shadow, exact eviction
+    # count, exact occupancy, gauge published from the same number
+    np.testing.assert_array_equal(ring._hash,
+                                  np.array(sh_hash, np.uint64))
+    np.testing.assert_array_equal(ring._expiry, np.array(sh_exp))
+    assert ring.evictions == shadow_evictions[0] > 0
+    assert ring.occupancy() == shadow_live(clock[0]) > 0
+    drive(universe[0], 10.0)      # republish the gauge at current clock
+    assert obs_metrics.INGEST_DEDUP_OCCUPANCY.value() == ring.occupancy()
+    # TTL boundary semantics: every key the ring still HOLDS answers
+    # exactly like the TTLSet oracle (keys the storm evicted may differ
+    # — that is the bounded-memory trade, and it is exactly counted)
+    held_hashes = set(int(h) for h in ring._hash if h != 0)
+    held = [fp for fp in universe if int(ring._h(fp)) in held_hashes]
+    assert held, "storm left nothing resident?"
+    mask = ring.contains_batch(held)
+    for fp, hit in zip(held, mask):
+        if hit:
+            assert fp in oracle, "ring invented membership vs the oracle"
+
+
 def test_dedup_facade_batch_semantics():
     cfg = load_settings(ingest_columnar=True, dedup_ttl_seconds=100)
     clock = [0.0]

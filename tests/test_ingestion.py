@@ -84,6 +84,29 @@ def test_rate_limiter_fixed_window():
     assert rl.check_rate_limit("c")  # new window
 
 
+def test_rate_limiter_prunes_stale_client_windows():
+    """graft-storm regression: ``_windows`` used to grow one entry per
+    distinct client key forever — a memory leak under a storm from many
+    source IPs. Entries from previous windows are pruned on the first
+    check after a window roll."""
+    clock = [0.0]
+    rl = RateLimiter(load_settings(webhook_rate_limit_per_minute=3),
+                     clock=lambda: clock[0])
+    for i in range(1000):
+        assert rl.check_rate_limit(f"ip-{i}")
+    assert rl.tracked_clients() == 1000
+    clock[0] += 61                       # window rolls
+    assert rl.check_rate_limit("fresh-client")
+    assert rl.tracked_clients() == 1     # the 1000 stale keys are gone
+    # the live window's keys survive a same-window sweep
+    assert rl.check_rate_limit("fresh-client")
+    assert rl.tracked_clients() == 1
+    # Retry-After derivation: seconds to the window roll, (0, 60]
+    clock[0] += 12.5
+    assert rl.retry_after_s() == pytest.approx(60.0 - (clock[0] % 60.0))
+    assert 0.0 < rl.retry_after_s() <= 60.0
+
+
 @pytest.fixture()
 def app():
     cluster = generate_cluster(num_pods=60, seed=2)
