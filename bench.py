@@ -1881,6 +1881,149 @@ def bench_recovery(num_pods: int = 35000, num_incidents: int = 100,
     }
 
 
+def bench_incident_lifecycle(num_pods: int = 120, incidents: int = 6,
+                             crash_rate: float = 0.35, seed: int = 0,
+                             verbose: bool = True) -> dict:
+    """graft-saga: the ``incident_lifecycle`` record.
+
+    Webhook→closed-incident MTTR with and without injected worker
+    crashes. The faulted arm kills the workflow (in-process WorkflowCrash
+    — the SIGKILL analog) on a seeded schedule across every lifecycle
+    stage boundary (collect | journal_put | wf_execute | verify |
+    compensate | crash_restart), waits out the lease, and resumes through
+    the journal-replay path exactly as the worker resumer would. Gated
+    claims: ZERO duplicate cluster mutations (counted at the
+    MutationRecorder backend seam) and a final incident/action/journal
+    state identical to the unfaulted twin; resumes and in-doubt
+    reconciliations are counted, MTTR reported for both arms."""
+    import asyncio
+    import re
+
+    from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+    from kubernetes_aiops_evidence_graph_tpu.rca.faults import (
+        WORKFLOW_STAGES, FaultInjector, MutationRecorder, WorkflowCrash)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        generate_cluster, inject)
+    from kubernetes_aiops_evidence_graph_tpu.storage import Database
+    from kubernetes_aiops_evidence_graph_tpu.workflow import (
+        run_incident_workflow)
+
+    log = (lambda *a: print(*a, file=sys.stderr)) if verbose \
+        else (lambda *a: None)
+    settings = load_settings(
+        app_env="development", remediation_dry_run=False,
+        verification_wait_seconds=0, rca_backend="cpu",
+        workflow_lease_enabled=True, workflow_lease_ttl_s=0.05,
+        workflow_resume_interval_s=0.0,
+        node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+        incident_bucket_sizes=(8, 32))
+    scenarios = ("crashloop_deploy", "oom", "hpa_maxed")
+
+    def build(arm_seed):
+        cluster = generate_cluster(num_pods=num_pods, seed=arm_seed)
+        rng = np.random.default_rng(arm_seed)
+        keys = sorted(cluster.deployments)
+        injected = [inject(cluster, scenarios[i % len(scenarios)],
+                           keys[(i * 3) % len(keys)], rng)
+                    for i in range(incidents)]
+        db = Database(":memory:")
+        for inc in injected:
+            db.create_incident(inc)
+        return MutationRecorder(cluster), injected, db
+
+    ts_re = r"\d{4}-\d{2}-\d{2}T[0-9:.]+(?:\+00:00|Z)?"
+
+    def scrub(text, inc):
+        # twin worlds differ ONLY in uuids + wall-clock timestamps
+        return re.sub(ts_re, "<ts>", text.replace(str(inc.id), "<id>"))
+
+    def norm_state(db, inc):
+        journal = {}
+        for step, e in db.journal_get(f"incident-{inc.id}").items():
+            res = json.dumps(e["result"], sort_keys=True, default=str)
+            journal[step] = (e["status"], scrub(res, inc))
+        actions = sorted(
+            (re.sub(r"_\d{10}", "", scrub(r["idempotency_key"], inc)),
+             r["action_type"], r["status"],
+             scrub(r["execution_result"] or "", inc))
+            for r in db.actions_for(inc.id))
+        return (db.get_incident(inc.id)["status"], journal, actions)
+
+    def drive(rec, db, inc, injector=None):
+        loop = asyncio.new_event_loop()
+        resumes = 0
+        try:
+            for _ in range(64):
+                try:
+                    loop.run_until_complete(run_incident_workflow(
+                        inc, rec, db, settings=settings, faults=injector))
+                    return resumes
+                except WorkflowCrash:
+                    resumes += 1
+                    time.sleep(0.08)    # the dead run's lease expires
+        finally:
+            loop.close()
+        raise RuntimeError("lifecycle never completed")
+
+    # unfaulted arm
+    rec_u, incs_u, db_u = build(seed)
+    mttr_u = []
+    for inc in incs_u:
+        t0 = time.perf_counter()
+        drive(rec_u, db_u, inc)
+        mttr_u.append(time.perf_counter() - t0)
+
+    # faulted arm: identical world, seeded crash schedule per incident
+    rec_f, incs_f, db_f = build(seed)
+    mttr_f, resumes_total = [], 0
+    for i, inc in enumerate(incs_f):
+        injector = FaultInjector.seeded(seed + 101 + i, ticks=2,
+                                        rate=crash_rate,
+                                        stages=WORKFLOW_STAGES)
+        t0 = time.perf_counter()
+        resumes_total += drive(rec_f, db_f, inc, injector)
+        mttr_f.append(time.perf_counter() - t0)
+
+    from collections import Counter
+    # "zero duplicate mutations": nothing fired more times than in the
+    # unfaulted twin (compensation legitimately repeats a signature)
+    duplicates = Counter(rec_f.calls) - Counter(rec_u.calls)
+    parity = all(norm_state(db_f, f) == norm_state(db_u, u)
+                 for f, u in zip(incs_f, incs_u))
+    mutations_equal = rec_f.calls == rec_u.calls
+    reconciliations = sum(
+        1 for r in db_f.query(
+            "SELECT detail FROM action_executions WHERE phase='result'")
+        if "reconciled" in (r["detail"] or ""))
+    mu = statistics.mean(mttr_u)
+    mf = statistics.mean(mttr_f)
+    log(f"incident_lifecycle: MTTR {mu*1e3:.0f} ms unfaulted vs "
+        f"{mf*1e3:.0f} ms under crashes ({resumes_total} resumes, "
+        f"{reconciliations} reconciliations, dup mutations "
+        f"{sum(duplicates.values())}, parity {parity and mutations_equal})")
+    db_u.close()
+    db_f.close()
+    return {
+        "metric": "incident_lifecycle",
+        "value": round(mf * 1e3, 1),
+        "unit": "ms webhook->closed-incident MTTR under injected crashes",
+        "vs_baseline": round(mf / max(mu, 1e-9), 2),
+        "mttr_unfaulted_ms": round(mu * 1e3, 1),
+        "mttr_faulted_ms": round(mf * 1e3, 1),
+        "mttr_faulted_p99_ms": round(
+            sorted(mttr_f)[int(0.99 * (len(mttr_f) - 1))] * 1e3, 1),
+        "incidents": incidents,
+        "resumes": resumes_total,
+        "reconciliations": reconciliations,
+        "duplicate_mutations": int(sum(duplicates.values())),
+        "mutations_identical": bool(mutations_equal),
+        "state_parity": bool(parity),
+        "crash_rate": crash_rate,
+        "lease_ttl_s": settings.workflow_lease_ttl_s,
+        "num_pods": num_pods,
+    }
+
+
 def bench_serving(num_pods: int = 200, incidents: int = 30,
                   verbose: bool = True) -> dict:
     """BASELINE configs[0], measured as the PRODUCT serves it: webhook →
@@ -2426,6 +2569,19 @@ def main(argv=None) -> int:
         except (Exception, SystemExit) as exc:
             print(json.dumps({
                 "metric": "webhook_storm",
+                "value": 0, "unit": "error", "vs_baseline": 0,
+                "error": str(exc)}), flush=True)
+        # graft-saga smoke: the crash-resumable lifecycle record (MTTR
+        # with/without injected worker crashes; the CI graft-saga job
+        # runs the same record and gates on zero duplicate mutations +
+        # state parity)
+        try:
+            print(json.dumps(bench_incident_lifecycle(
+                num_pods=80, incidents=4, crash_rate=0.35,
+                verbose=False)), flush=True)
+        except (Exception, SystemExit) as exc:
+            print(json.dumps({
+                "metric": "incident_lifecycle",
                 "value": 0, "unit": "error", "vs_baseline": 0,
                 "error": str(exc)}), flush=True)
         # graft-evolve smoke: the online-learning record at laptop scale

@@ -134,6 +134,14 @@ class AiopsApp:
             target=self._loop.run_forever, daemon=True, name="kaeg-worker-loop")
         self._loop_thread.start()
         asyncio.run_coroutine_threadsafe(self.worker.start(), self._loop).result()
+        # graft-saga startup sweep: a PREVIOUS process that died mid-
+        # workflow left incidents stuck INVESTIGATING with expired leases
+        # — reclaim and re-enter them through the journal-replay path
+        # before taking new traffic (the periodic sweep keeps watching)
+        resumed = asyncio.run_coroutine_threadsafe(
+            self.worker.resume_orphans(), self._loop).result()
+        if resumed:
+            log.info("startup_resume_sweep", resumed=resumed)
 
         self._server = make_server(
             self, host or self.settings.api_host,

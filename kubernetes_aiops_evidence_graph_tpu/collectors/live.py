@@ -503,6 +503,12 @@ class LiveClusterBackend:
             "PATCH", f"/api/v1/nodes/{name}",
             {"spec": {"unschedulable": True}})
 
+    def uncordon_node(self, name: str) -> bool:
+        """unschedulable=false (graft-saga compensation inverse)."""
+        return self._k8s_write(
+            "PATCH", f"/api/v1/nodes/{name}",
+            {"spec": {"unschedulable": False}})
+
 
 def make_backend(settings: Settings | None = None, **overrides) -> Any:
     """cluster_backend setting -> backend instance (fake needs a cluster

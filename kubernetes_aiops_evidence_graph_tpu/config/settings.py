@@ -156,6 +156,41 @@ class Settings:
     verification_wait_seconds: int = 120           # incident_workflow.py:229
     approval_timeout_seconds: int = 4 * 3600       # incident_workflow.py:198
 
+    # --- graft-saga: durable exactly-once remediation ---
+    # two-phase action execution rides the SQLite ``action_executions``
+    # ledger unconditionally (an intent row + idempotency key lands
+    # BEFORE the cluster mutation, the result row after; an intent
+    # without a result is in-doubt on resume and is RECONCILED by
+    # probing cluster state, never blindly re-fired). These knobs cover
+    # the satellite surfaces around it.
+    # upper bound for scale_replicas remediation (the reference's
+    # current+1 default was unbounded — a flapping workflow could walk a
+    # deployment to absurd replica counts one approved action at a time)
+    remediation_max_scale_replicas: int = 10
+    # saga compensation: a FAILED verification rolls the action's cluster
+    # effect back (scale -> restore the pre-action replica count captured
+    # at execute time, cordon -> uncordon, rollback -> re-rollback;
+    # restart-class actions are self-healing no-ops), policy-gated via
+    # PolicyEngine.evaluate_compensation, bounded attempts, then an
+    # escalate-to-human action row
+    remediation_compensation: bool = True
+    remediation_compensation_attempts: int = 2
+    # workflow leases: run_incident_workflow acquires a fenced lease row
+    # in workflow_journal before touching the incident; heartbeats extend
+    # it while the run is live, and a worker that loses the lease
+    # (expired + reclaimed by the resumer) is FENCED out at the next step
+    # boundary instead of double-driving the workflow
+    workflow_lease_enabled: bool = True
+    workflow_lease_ttl_s: float = 60.0
+    # resumer sweep cadence (worker.py): reclaim expired leases and
+    # re-enter run_incident_workflow through the journal-replay path.
+    # 0 disables the periodic loop (the startup sweep still runs).
+    workflow_resume_interval_s: float = 30.0
+    # resume budget per workflow (the lease token counts acquisitions):
+    # past this many the workflow is left STALLED for operators instead
+    # of hot-looping a deterministic failure
+    workflow_max_resumes: int = 5
+
     # --- integrations ---
     slack_webhook_url: str = ""
     slack_channel: str = "#incidents"

@@ -486,7 +486,22 @@ class ApiHandler(BaseHTTPRequestHandler):
 
     @route("GET", "/api/v1/workflows")
     def list_workflows(self):
-        self._json(200, {"workflows": self.app.db.journal_workflows()})
+        # graft-saga: surface STALLED workflows (failed step / exhausted
+        # resume budget on an open incident) so the resumer's blind spot
+        # is an operator's first glance, and stamp the gauge while here
+        from ..observability import metrics as obs_metrics
+        max_resumes = int(getattr(self.app.settings,
+                                  "workflow_max_resumes", 5))
+        stalled = self.app.db.stalled_workflows(max_resumes=max_resumes)
+        obs_metrics.WORKFLOW_STALLED.set(float(len(stalled)))
+        stalled_ids = {s["workflow_id"]: s["reason"] for s in stalled}
+        workflows = self.app.db.journal_workflows()
+        for w in workflows:
+            w["stalled"] = w["workflow_id"] in stalled_ids
+            if w["stalled"]:
+                w["stalled_reason"] = stalled_ids[w["workflow_id"]]
+        self._json(200, {"workflows": workflows,
+                         "stalled": stalled})
 
     @route("GET", r"/api/v1/workflows/(?P<workflow_id>[A-Za-z0-9_.:-]+)")
     def workflow_timeline(self, workflow_id: str):

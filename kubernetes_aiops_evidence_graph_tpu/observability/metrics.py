@@ -204,6 +204,55 @@ WORKFLOW_STEPS = REGISTRY.counter(
     "Workflow step outcomes by status (completed|failed) — feeds the "
     "WorkflowFailures alert rule")
 
+# graft-saga instrumentation (workflow/engine.py, workflow/worker.py,
+# remediation/executor.py + compensator.py): the durable exactly-once
+# remediation lifecycle. Every intent/result/reconciliation, lease
+# fencing event, resume, orphaned step thread, and compensation outcome
+# is counted — the action trail behind a verdict must be as auditable
+# as the verdict itself.
+WORKFLOW_STEP_ORPHANS = REGISTRY.counter(
+    "aiops_workflow_step_orphans_total",
+    "Sync workflow steps whose executor THREAD outlived the step "
+    "timeout (asyncio.wait_for cannot cancel a thread — the step keeps "
+    "running detached while the engine retries/fails), by step")
+WORKFLOW_STALLED = REGISTRY.gauge(
+    "aiops_workflow_stalled",
+    "Workflows currently stalled: open incidents whose journal carries "
+    "a failed step or whose resume budget is exhausted — visible to the "
+    "resumer sweep and GET /api/v1/workflows")
+WORKFLOW_RESUMES = REGISTRY.counter(
+    "aiops_workflow_resumes_total",
+    "Orphaned workflows (expired lease, no failed steps) re-entered "
+    "through the journal-replay path by the resumer sweep")
+WORKFLOW_LEASE_FENCED = REGISTRY.counter(
+    "aiops_workflow_lease_fenced_total",
+    "Workflow runs aborted at a step boundary because their lease was "
+    "lost (expired and reclaimed by another worker) — the fencing that "
+    "keeps two workers from double-driving one workflow")
+ACTION_INTENTS = REGISTRY.counter(
+    "aiops_action_intents_total",
+    "Two-phase execution intent rows journaled BEFORE a cluster "
+    "mutation dispatch, by action_type")
+ACTION_DUP_PREVENTED = REGISTRY.counter(
+    "aiops_action_duplicates_prevented_total",
+    "Action executions answered from the durable ledger's recorded "
+    "result instead of re-firing the cluster mutation (journal-replay "
+    "after a crash between the mutation and the step commit)")
+ACTION_RECONCILED = REGISTRY.counter(
+    "aiops_action_reconciliations_total",
+    "In-doubt executions (intent without result after a crash) settled "
+    "by probing cluster state, by outcome (completed = the mutation had "
+    "landed; refired = the probe proved it had not)")
+COMPENSATION_ACTIONS = REGISTRY.counter(
+    "aiops_compensation_actions_total",
+    "Saga compensation executions after a failed verification, by "
+    "action_type and outcome (completed | failed | denied | noop)")
+COMPENSATION_ESCALATIONS = REGISTRY.counter(
+    "aiops_compensation_escalations_total",
+    "Compensations that exhausted their bounded attempts (or were "
+    "policy-denied) and escalated to a human via an "
+    "escalate_to_human action row")
+
 # graft-intake instrumentation (ingestion/columnar.py + the columnar
 # staging path in rca/streaming.py): the webhook→staged-delta segment,
 # previously the one part of the serving path with no metric surface.

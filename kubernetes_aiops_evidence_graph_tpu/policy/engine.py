@@ -192,3 +192,34 @@ class PolicyEngine:
             "requires_approval": result.requires_approval,
             "reason": result.reason,
         }
+
+    def evaluate_compensation(
+        self,
+        original_action_type: str,
+        environment: str,
+        namespace: str,
+    ) -> dict:
+        """graft-saga compensation gate. Compensation RESTORES the
+        pre-action state of an action this engine already allowed and an
+        approver already signed off on, so the question is not "would the
+        inverse action pass as a fresh proposal" (uncordon_node is
+        HIGH_RISK and never would) but "is the original action class
+        still within this environment's remit". Freeze windows are
+        deliberately NOT applied: leaving a failed remediation's mutation
+        standing through a freeze is worse than undoing it."""
+        env = {"development": "dev", "production": "prod"}.get(
+            environment.lower(), environment.lower())
+        allowed_set = ALLOWED_ACTIONS.get(env)
+        reasons: list[str] = []
+        if allowed_set is None:
+            reasons.append(f"Environment {env} has no action allowlist")
+        elif original_action_type not in allowed_set:
+            reasons.append(f"Action {original_action_type} is not in the"
+                           f" {env} allowlist")
+        if env != "dev" and namespace in PROTECTED_NAMESPACES:
+            reasons.append(f"Namespace {namespace} is protected")
+        return {
+            "allow": not reasons,
+            "requires_approval": False,  # covered by the original approval
+            "reason": "; ".join(reasons) if reasons else None,
+        }

@@ -67,10 +67,42 @@ TICK_STAGES = ("staging", "dispatch", "pack", "execute", "fetch",
 # graft-storm: the previously-uncovered halves of the pipeline
 INGEST_STAGES = ("parse", "dedup", "persist", "admit")
 LEARN_STAGES = ("harvest", "swap")
-STAGES = TICK_STAGES + INGEST_STAGES + LEARN_STAGES
+# graft-saga: the incident-lifecycle stage boundaries (the back half,
+# verdict → remediation → verified closure). The tick pipeline reuses
+# "execute" as a stage name; lifecycle hooks are distinct call sites
+# (workflow steps + the two-phase executor), so the shared name never
+# aliases — one injector drives one pipeline at a time.
+#
+# * ``collect``      — inside collect_evidence, before evidence persists
+# * ``journal_put``  — engine boundary: the step ran, its journal commit
+#                      has not (the classic lost-commit crash)
+# * ``wf_execute``   — inside the two-phase executor: the CLUSTER
+#                      MUTATION landed, the ledger result row has not —
+#                      resume must reconcile, never re-fire
+# * ``verify``       — inside verify_remediation, before the verdict
+# * ``compensate``   — inside the saga compensation step
+# * ``crash_restart``— immediately after a resumed run re-acquires the
+#                      lease (a worker that dies again right away)
+WORKFLOW_STAGES = ("collect", "journal_put", "wf_execute", "verify",
+                   "compensate", "crash_restart")
+STAGES = TICK_STAGES + INGEST_STAGES + LEARN_STAGES + WORKFLOW_STAGES
 
 # value-corruption stages return poisoned data instead of raising
 _POISON_STAGES = frozenset({"delta_values"})
+
+
+class WorkflowCrash(BaseException):
+    """A simulated worker death at a lifecycle stage boundary. Derives
+    from BaseException ON PURPOSE: every per-step / per-incident handler
+    catches Exception, and a crash must tear the whole run down exactly
+    the way SIGKILL would — no retry, no audit row, no lease release.
+    The chaos harness catches it at the process-boundary analog and
+    resumes through the journal-replay path like a fresh worker."""
+
+    def __init__(self, stage: str, visit: int):
+        super().__init__(f"injected crash at {stage} (visit {visit})")
+        self.stage = stage
+        self.visit = visit
 
 
 class InjectedFault(RuntimeError):
@@ -125,6 +157,10 @@ class FaultInjector:
                     kind = "poison"
                 elif stage == "execute" and rng.random() < 0.5:
                     kind = "device_loss"
+                elif stage in WORKFLOW_STAGES:
+                    # lifecycle stages simulate worker DEATH, not a
+                    # retryable step error — the resumer must drain them
+                    kind = "crash"
                 else:
                     kind = "raise"
                 faults.append(Fault(stage=stage, at=int(at), kind=kind))
@@ -149,6 +185,8 @@ class FaultInjector:
         visit = self.visits[stage] - 1
         self.fired.append((stage, f.kind, visit))
         log.warning("fault_injected", stage=stage, kind=f.kind, visit=visit)
+        if f.kind == "crash":
+            raise WorkflowCrash(stage, visit)
         if f.kind == "stall":
             time.sleep(self.stall_seconds)
             return                      # completes, but past the watchdog
@@ -197,3 +235,34 @@ class FaultInjector:
         if feats is not None:
             scorer._features_dev = jnp.full(
                 feats.shape, jnp.nan, dtype=feats.dtype)
+
+
+class MutationRecorder:
+    """graft-saga counting seam: wraps a cluster backend and records
+    every cluster-MUTATING call as (method, *str(args)). The chaos
+    sweeps assert exactly-once remediation on this ledger — a crash
+    anywhere in the lifecycle (including between the cluster mutation
+    and the journal commit) must yield ZERO duplicate mutations across
+    all resume cycles. Reads pass through untouched."""
+
+    MUTATORS = frozenset({
+        "delete_pod", "restart_deployment", "rollback_deployment",
+        "scale_deployment", "cordon_node", "uncordon_node",
+    })
+
+    def __init__(self, backend: Any) -> None:
+        self._backend = backend
+        self.calls: list[tuple] = []
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._backend, name)
+        if name in self.MUTATORS and callable(attr):
+            def wrapped(*a: Any, _attr=attr, _name=name, **k: Any) -> Any:
+                self.calls.append((_name,) + tuple(str(x) for x in a))
+                return _attr(*a, **k)
+            return wrapped
+        return attr
+
+    def duplicates(self) -> list[tuple]:
+        from collections import Counter
+        return [c for c, n in Counter(self.calls).items() if n > 1]

@@ -139,4 +139,10 @@ class RemediationOrchestrator:
                     else ActionStatus.REJECTED),
             status_reason=policy_result.get("reason"),
             requires_approval=requires_approval,
+            # graft-saga: the saga compensator can invert these classes
+            # (scale → prior replicas, cordon → uncordon, rollback →
+            # re-rollback); restart-class actions self-heal instead
+            can_rollback=action_enum in (ActionType.SCALE_REPLICAS,
+                                         ActionType.CORDON_NODE,
+                                         ActionType.ROLLBACK_DEPLOYMENT),
         )

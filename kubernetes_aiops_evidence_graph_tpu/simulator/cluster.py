@@ -450,3 +450,11 @@ class FakeCluster:
             return False
         node.conditions["Unschedulable"] = "True"
         return True
+
+    def uncordon_node(self, name: str) -> bool:
+        """Clear the cordon (graft-saga compensation inverse)."""
+        node = self.nodes.get(name)
+        if node is None:
+            return False
+        node.conditions.pop("Unschedulable", None)
+        return True

@@ -156,8 +156,24 @@ CREATE TABLE IF NOT EXISTS workflow_journal (
     attempts INTEGER NOT NULL DEFAULT 0,
     duration_s REAL,
     updated_at TEXT NOT NULL DEFAULT (strftime('%Y-%m-%dT%H:%M:%fZ','now')),
+    lease_owner TEXT,
+    lease_deadline REAL,
+    lease_token INTEGER NOT NULL DEFAULT 0,
     PRIMARY KEY (workflow_id, step)
 );
+
+CREATE TABLE IF NOT EXISTS action_executions (
+    idempotency_key TEXT NOT NULL,
+    phase TEXT NOT NULL CHECK (phase IN ('intent','result')),
+    action_id TEXT,
+    incident_id TEXT,
+    action_type TEXT,
+    status TEXT,
+    detail TEXT NOT NULL DEFAULT '{}',
+    at TEXT NOT NULL DEFAULT (strftime('%Y-%m-%dT%H:%M:%fZ','now')),
+    PRIMARY KEY (idempotency_key, phase)
+);
+CREATE INDEX IF NOT EXISTS ix_exec_incident ON action_executions(incident_id);
 
 CREATE TRIGGER IF NOT EXISTS trg_incidents_updated
 AFTER UPDATE ON incidents FOR EACH ROW
@@ -179,6 +195,17 @@ class DuplicateIncidentError(Exception):
 
 def _iso(dt: Optional[datetime]) -> Optional[str]:
     return dt.isoformat() if dt else None
+
+
+# the dedicated journal row the workflow lease rides (filtered out of
+# every step-level surface); wall clock because lease deadlines must be
+# comparable ACROSS worker processes
+_LEASE_STEP = "__lease__"
+
+
+def _now() -> float:
+    import time
+    return time.time()  # graft-audit: allow[wall-clock] lease deadlines must be comparable ACROSS worker processes; monotonic clocks are per-process
 
 
 class Database:
@@ -208,12 +235,20 @@ class Database:
             # which two contending worker processes can trip over
             cols = {r[1] for r in self._anchor.execute(
                 "PRAGMA table_info(workflow_journal)")}
-            if "duration_s" not in cols:
-                try:
-                    self._anchor.execute("ALTER TABLE workflow_journal"
-                                         " ADD COLUMN duration_s REAL")
-                except sqlite3.OperationalError:
-                    pass  # a racing migrator added it first
+            for col, decl in (
+                    ("duration_s", "duration_s REAL"),
+                    # graft-saga lease/heartbeat columns: the lease rides
+                    # a dedicated (workflow_id, '__lease__') row
+                    ("lease_owner", "lease_owner TEXT"),
+                    ("lease_deadline", "lease_deadline REAL"),
+                    ("lease_token",
+                     "lease_token INTEGER NOT NULL DEFAULT 0")):
+                if col not in cols:
+                    try:
+                        self._anchor.execute(
+                            f"ALTER TABLE workflow_journal ADD COLUMN {decl}")
+                    except sqlite3.OperationalError:
+                        pass  # a racing migrator added it first
             self._anchor.commit()
 
     def _connect(self) -> sqlite3.Connection:
@@ -468,7 +503,8 @@ class Database:
                         "duration_s": r["duration_s"],
                         "updated_at": r["updated_at"]}
             for r in self.query(
-                "SELECT * FROM workflow_journal WHERE workflow_id=?", (workflow_id,))
+                "SELECT * FROM workflow_journal WHERE workflow_id=?"
+                f" AND step != '{_LEASE_STEP}'", (workflow_id,))
         }
 
     def journal_put(self, workflow_id: str, step: str, status: str,
@@ -508,7 +544,8 @@ class Database:
             " SUM(COALESCE(duration_s, 0)) AS total_duration_s,"
             " MIN(updated_at) AS first_update,"
             " MAX(updated_at) AS last_update"
-            " FROM workflow_journal GROUP BY workflow_id"
+            f" FROM workflow_journal WHERE step != '{_LEASE_STEP}'"
+            " GROUP BY workflow_id"
             " ORDER BY last_update DESC LIMIT ?", (limit,))
         out = []
         for r in rows:
@@ -517,6 +554,170 @@ class Database:
                                            d["completed"])
             out.append(d)
         return out
+
+    # -- workflow leases (graft-saga) -------------------------------------
+    # The lease rides a dedicated (workflow_id, '__lease__') journal row
+    # using the lease_* columns: lease_owner/lease_deadline are the live
+    # claim, lease_token is a fencing token that increments on every
+    # acquisition (so it doubles as the resume count). All comparisons
+    # use wall-clock time.time() — the only clock two worker PROCESSES
+    # share.
+
+    def lease_acquire(self, workflow_id: str, owner: str, ttl_s: float,
+                      now: float | None = None) -> Optional[int]:
+        """Atomically claim the workflow lease. Returns the fencing token
+        when acquired, None while another owner's lease is live."""
+        now = _now() if now is None else now
+        with self._lock:
+            self.conn.execute(
+                "INSERT INTO workflow_journal (workflow_id, step, status,"
+                " lease_owner, lease_deadline, lease_token)"
+                " VALUES (?,?, 'lease', ?, ?, 1)"
+                " ON CONFLICT(workflow_id, step) DO UPDATE SET"
+                " lease_owner=excluded.lease_owner,"
+                " lease_deadline=excluded.lease_deadline,"
+                " lease_token=workflow_journal.lease_token+1,"
+                " updated_at=strftime('%Y-%m-%dT%H:%M:%fZ','now')"
+                " WHERE workflow_journal.lease_deadline IS NULL"
+                "    OR workflow_journal.lease_deadline < ?",
+                (workflow_id, _LEASE_STEP, owner, now + ttl_s, now))
+            self.conn.commit()
+            row = self.conn.execute(
+                "SELECT lease_owner, lease_token FROM workflow_journal"
+                " WHERE workflow_id=? AND step=?",
+                (workflow_id, _LEASE_STEP)).fetchone()
+        if row is not None and row["lease_owner"] == owner:
+            return int(row["lease_token"])
+        return None
+
+    def lease_heartbeat(self, workflow_id: str, owner: str, token: int,
+                        ttl_s: float, now: float | None = None) -> bool:
+        """Extend the lease iff (owner, token) still hold it — False means
+        the caller has been FENCED (the lease expired and someone else
+        reclaimed it) and must stop driving the workflow."""
+        now = _now() if now is None else now
+        cur = self.execute(
+            "UPDATE workflow_journal SET lease_deadline=?"
+            " WHERE workflow_id=? AND step=? AND lease_owner=?"
+            " AND lease_token=?",
+            (now + ttl_s, workflow_id, _LEASE_STEP, owner, token))
+        return cur.rowcount > 0
+
+    def lease_release(self, workflow_id: str, owner: str, token: int) -> bool:
+        """Clear the claim (owner/deadline NULL); the token stays as the
+        monotonic acquisition count. Owner+token matched, so a fenced
+        zombie releasing late is a no-op."""
+        cur = self.execute(
+            "UPDATE workflow_journal SET lease_owner=NULL,"
+            " lease_deadline=NULL"
+            " WHERE workflow_id=? AND step=? AND lease_owner=?"
+            " AND lease_token=?",
+            (workflow_id, _LEASE_STEP, owner, token))
+        return cur.rowcount > 0
+
+    def lease_view(self, workflow_id: str) -> Optional[dict]:
+        rows = self.query(
+            "SELECT lease_owner, lease_deadline, lease_token, updated_at"
+            " FROM workflow_journal WHERE workflow_id=? AND step=?",
+            (workflow_id, _LEASE_STEP))
+        if not rows:
+            return None
+        r = rows[0]
+        return {"owner": r["lease_owner"], "deadline": r["lease_deadline"],
+                "token": r["lease_token"], "updated_at": r["updated_at"]}
+
+    def orphaned_incidents(self, max_resumes: int = 5,
+                           now: float | None = None) -> list[dict]:
+        """Open incidents whose workflow lease EXPIRED (worker died
+        mid-run: the deadline is non-NULL and past) with no failed steps
+        and resume budget left — the resumer sweep re-enters these
+        through the journal-replay path. A clean release NULLs the
+        deadline, so legitimately finished or failed runs never match."""
+        now = _now() if now is None else now
+        return [{**_incident_row(r), "resumes": r["resumes"]}
+                for r in self.query(
+            "SELECT i.*, l.lease_token AS resumes FROM incidents i"
+            " JOIN workflow_journal l ON l.workflow_id = 'incident-' || i.id"
+            f" AND l.step = '{_LEASE_STEP}'"
+            " WHERE i.status IN ('investigating','remediating')"
+            " AND l.lease_deadline IS NOT NULL AND l.lease_deadline < ?"
+            " AND l.lease_token < ?"
+            " AND NOT EXISTS (SELECT 1 FROM workflow_journal f"
+            "  WHERE f.workflow_id = l.workflow_id AND f.status='failed')",
+            (now, max_resumes))]
+
+    def stalled_workflows(self, max_resumes: int = 5,
+                          now: float | None = None) -> list[dict]:
+        """Workflows an operator must look at: the incident is still open
+        but the journal carries a failed step, or the resume budget is
+        exhausted. Surfaced by GET /api/v1/workflows and stamped into the
+        aiops_workflow_stalled gauge."""
+        now = _now() if now is None else now
+        rows = self.query(
+            "SELECT DISTINCT j.workflow_id, i.id AS incident_id,"
+            " CASE WHEN EXISTS (SELECT 1 FROM workflow_journal f"
+            "   WHERE f.workflow_id = j.workflow_id AND f.status='failed')"
+            "  THEN 'step_failed' ELSE 'resume_budget' END AS reason"
+            " FROM workflow_journal j"
+            " JOIN incidents i ON j.workflow_id = 'incident-' || i.id"
+            " WHERE i.status NOT IN ('resolved','closed')"
+            " AND (EXISTS (SELECT 1 FROM workflow_journal f"
+            "   WHERE f.workflow_id = j.workflow_id AND f.status='failed')"
+            f"  OR (j.step = '{_LEASE_STEP}' AND j.lease_token >= ?"
+            "   AND j.lease_deadline IS NOT NULL AND j.lease_deadline < ?))",
+            (max_resumes, now))
+        return [dict(r) for r in rows]
+
+    # -- action execution ledger (graft-saga two-phase execute) -----------
+
+    def execution_intent(self, idempotency_key: str, action_id: str,
+                         incident_id: str, action_type: str,
+                         detail: dict | None = None) -> bool:
+        """Journal the INTENT to mutate the cluster — written (and
+        fsync'd by SQLite) BEFORE the dispatch. Returns False when an
+        intent already exists (resume path). The detail carries whatever
+        reconciliation will need: the pre-action probe and the captured
+        verification baseline."""
+        with self._lock:
+            cur = self.conn.execute(
+                "INSERT OR IGNORE INTO action_executions (idempotency_key,"
+                " phase, action_id, incident_id, action_type, detail)"
+                " VALUES (?, 'intent', ?, ?, ?, ?)",
+                (idempotency_key, action_id, incident_id, action_type,
+                 json.dumps(detail or {}, default=str)))
+            self.conn.commit()
+            return cur.rowcount > 0
+
+    def execution_result(self, idempotency_key: str, status: str,
+                         detail: dict | None = None) -> None:
+        """Journal the outcome of a dispatched (or reconciled) execution;
+        idempotent upsert so a replayed commit is harmless."""
+        self.execute(
+            "INSERT INTO action_executions (idempotency_key, phase, status,"
+            " detail) VALUES (?, 'result', ?, ?)"
+            " ON CONFLICT(idempotency_key, phase) DO UPDATE SET"
+            " status=excluded.status, detail=excluded.detail",
+            (idempotency_key, status, json.dumps(detail or {}, default=str)))
+
+    def execution_state(self, idempotency_key: str) -> dict:
+        """{'intent': row|None, 'result': row|None} — intent without
+        result == IN-DOUBT (crashed between mutation and commit): the
+        caller must reconcile against cluster state, never re-fire."""
+        out: dict[str, Any] = {"intent": None, "result": None}
+        for r in self.query(
+                "SELECT * FROM action_executions WHERE idempotency_key=?",
+                (idempotency_key,)):
+            out[r["phase"]] = {**dict(r), "detail": json.loads(r["detail"])}
+        return out
+
+    def in_doubt_executions(self) -> list[dict]:
+        return [
+            {**dict(r), "detail": json.loads(r["detail"])}
+            for r in self.query(
+                "SELECT * FROM action_executions i WHERE phase='intent'"
+                " AND NOT EXISTS (SELECT 1 FROM action_executions r"
+                "  WHERE r.idempotency_key = i.idempotency_key"
+                "  AND r.phase='result')")]
 
     def close(self) -> None:
         with self._lock:

@@ -1,4 +1,11 @@
-from .engine import NonRetryableError, RetryPolicy, Step, StepFailed, WorkflowEngine
+from .engine import (
+    NonRetryableError,
+    RetryPolicy,
+    Step,
+    StepFailed,
+    WorkflowEngine,
+    WorkflowFenced,
+)
 from .incident_workflow import (
     IncidentContext,
     incident_steps,
@@ -8,6 +15,6 @@ from .worker import IncidentWorker
 
 __all__ = [
     "WorkflowEngine", "Step", "RetryPolicy", "StepFailed", "NonRetryableError",
-    "IncidentContext", "incident_steps", "run_incident_workflow",
-    "IncidentWorker",
+    "WorkflowFenced", "IncidentContext", "incident_steps",
+    "run_incident_workflow", "IncidentWorker",
 ]

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Sequence
 
 from ..observability import WORKFLOW_STEP_DURATION, WORKFLOW_STEPS, TRACER, get_logger
+from ..observability import metrics as obs_metrics
 from ..observability.scope import SCOPE
 from ..storage import Database
 
@@ -29,6 +30,17 @@ log = get_logger("workflow")
 
 class NonRetryableError(Exception):
     """Fail the step immediately (reference non_retryable_error_types)."""
+
+
+class WorkflowFenced(Exception):
+    """graft-saga: this run's lease was lost (expired and reclaimed by
+    another worker). The loser must stop driving the workflow at the next
+    step boundary — the winner owns the journal now. Benign by design:
+    the workflow continues elsewhere."""
+
+    def __init__(self, workflow_id: str):
+        super().__init__(f"lease for {workflow_id} lost; fenced out")
+        self.workflow_id = workflow_id
 
 
 @dataclass(frozen=True)
@@ -85,9 +97,19 @@ class WorkflowEngine:
         self.db = db
         self._sleep = sleeper  # injectable for tests
 
-    async def run(self, workflow_id: str, steps: Sequence[Step], ctx: Any) -> dict:
+    async def run(self, workflow_id: str, steps: Sequence[Step], ctx: Any,
+                  lease: "tuple[str, int] | None" = None,
+                  lease_ttl_s: float = 60.0) -> dict:
         """Run (or resume) a workflow. Returns {step: result}. Completed
-        steps in the journal are replayed, not re-executed."""
+        steps in the journal are replayed, not re-executed.
+
+        graft-saga: when ``lease=(owner, token)`` is supplied, the engine
+        heartbeats the lease on a background task (so steps longer than
+        the TTL — the 4h approval wait — stay covered) and FENCES at
+        every step boundary: a heartbeat that no longer matches
+        (owner, token) means the lease expired and another worker
+        reclaimed the workflow, so this run raises WorkflowFenced instead
+        of double-driving the journal."""
         journal = self.db.journal_get(workflow_id)
         results: dict[str, Any] = {}
         for entry_name, entry in journal.items():
@@ -96,20 +118,44 @@ class WorkflowEngine:
         if hasattr(ctx, "results"):
             ctx.results.update(results)
 
-        for step in steps:
-            if step.name in results:
-                log.debug("step_replayed", workflow=workflow_id, step=step.name)
-                continue
-            if step.condition is not None and not step.condition(ctx):
-                self.db.journal_put(workflow_id, step.name, "skipped", None)
-                results[step.name] = None
+        hb_task: asyncio.Task | None = None
+        if lease is not None:
+            owner, token = lease
+
+            async def _heartbeat() -> None:
+                period = max(lease_ttl_s / 3.0, 0.02)
+                while True:
+                    await asyncio.sleep(period)
+                    if not self.db.lease_heartbeat(workflow_id, owner,
+                                                   token, lease_ttl_s):
+                        return  # fenced; the boundary check raises
+
+            hb_task = asyncio.get_event_loop().create_task(_heartbeat())
+        try:
+            for step in steps:
+                if step.name in results:
+                    log.debug("step_replayed", workflow=workflow_id,
+                              step=step.name)
+                    continue
+                if lease is not None and not self.db.lease_heartbeat(
+                        workflow_id, lease[0], lease[1], lease_ttl_s):
+                    obs_metrics.WORKFLOW_LEASE_FENCED.inc()
+                    log.warning("workflow_fenced", workflow=workflow_id,
+                                step=step.name)
+                    raise WorkflowFenced(workflow_id)
+                if step.condition is not None and not step.condition(ctx):
+                    self.db.journal_put(workflow_id, step.name, "skipped", None)
+                    results[step.name] = None
+                    if hasattr(ctx, "results"):
+                        ctx.results[step.name] = None
+                    continue
+                result = await self._run_step(workflow_id, step, ctx)
+                results[step.name] = result
                 if hasattr(ctx, "results"):
-                    ctx.results[step.name] = None
-                continue
-            result = await self._run_step(workflow_id, step, ctx)
-            results[step.name] = result
-            if hasattr(ctx, "results"):
-                ctx.results[step.name] = result
+                    ctx.results[step.name] = result
+        finally:
+            if hb_task is not None:
+                hb_task.cancel()
         return results
 
     async def _run_step(self, workflow_id: str, step: Step, ctx: Any) -> Any:
@@ -138,14 +184,37 @@ class WorkflowEngine:
                         def _run_attached(fn=step.fn, span=step_span):
                             with TRACER.attach(span):
                                 return fn(ctx)
-                        result = await asyncio.wait_for(
-                            asyncio.get_event_loop().run_in_executor(
-                                None, _run_attached),
-                            timeout=step.timeout_s)
+                        try:
+                            result = await asyncio.wait_for(
+                                asyncio.get_event_loop().run_in_executor(
+                                    None, _run_attached),
+                                timeout=step.timeout_s)
+                        except asyncio.TimeoutError:
+                            # CAVEAT (graft-saga satellite): wait_for
+                            # cancels the asyncio wrapper, but an executor
+                            # THREAD cannot be cancelled — the step keeps
+                            # running detached and its side effects may
+                            # still land after this "timeout". Counted
+                            # and logged so an orphan storm is visible;
+                            # two-phase ledgered actions stay exactly-once
+                            # regardless (the orphan's late result commit
+                            # is an idempotent upsert).
+                            obs_metrics.WORKFLOW_STEP_ORPHANS.inc(
+                                step=step.name)
+                            log.warning("step_thread_orphaned",
+                                        workflow=workflow_id,
+                                        step=step.name,
+                                        timeout_s=step.timeout_s)
+                            raise
                 json.dumps(result, default=str)  # journal-serializable check
                 dt = time.perf_counter() - t0
                 WORKFLOW_STEP_DURATION.observe(dt, step=step.name)
                 WORKFLOW_STEPS.inc(step=step.name, status="completed")
+                # chaos boundary: the step's effects are live, its journal
+                # commit is not — the classic lost-commit crash window
+                inj = getattr(ctx, "faults", None)
+                if inj is not None:
+                    inj.at("journal_put")
                 self.db.journal_put(workflow_id, step.name, "completed",
                                     result, attempts=attempts, duration_s=dt)
                 return result
@@ -170,6 +239,13 @@ class WorkflowEngine:
         done = [s for s, e in journal.items() if e["status"] == "completed"]
         failed = [s for s, e in journal.items() if e["status"] == "failed"]
         running = [s for s, e in journal.items() if e["status"] == "running"]
+        # graft-saga: lease + stalled visibility — the resumer and
+        # operators must be able to SEE a workflow that is going nowhere
+        # (failed step, or an expired lease nobody reclaimed yet)
+        lease = self.db.lease_view(workflow_id)
+        lease_expired = bool(
+            lease and lease["deadline"] is not None
+            and lease["deadline"] < time.time())  # graft-audit: allow[wall-clock] lease deadlines are cross-process wall-clock values (storage/sqlite._now)
         return {
             "workflow_id": workflow_id,
             "steps": journal,
@@ -178,4 +254,6 @@ class WorkflowEngine:
             "running": running,
             "state": self.db.rollup_state(
                 len(failed), len(running), len(done)),
+            "lease": lease,
+            "stalled": bool(failed) or lease_expired,
         }

@@ -48,11 +48,16 @@ from ..observability import (
 )
 from ..rca import get_backend
 from ..rca.llm import LLMSummarizer
-from ..remediation import RemediationExecutor, RemediationOrchestrator, RemediationVerifier
+from ..remediation import (
+    RemediationCompensator,
+    RemediationExecutor,
+    RemediationOrchestrator,
+    RemediationVerifier,
+)
 from ..runbook import RunbookGenerator
 from ..storage import Database
 from ..utils.timeutils import utcnow
-from .engine import Step, WorkflowEngine
+from .engine import Step, StepFailed, WorkflowEngine, WorkflowFenced
 
 log = get_logger("incident_workflow")
 
@@ -78,6 +83,15 @@ class IncidentContext:
     slack: SlackClient | None = None
     jira: JiraClient | None = None
     dedup: Any = None  # AlertDeduplicator; fingerprint released on close
+    # graft-saga chaos seam: a rca/faults.FaultInjector whose lifecycle
+    # hooks (collect | journal_put | wf_execute | verify | compensate |
+    # crash_restart) fire at the stage boundaries below
+    faults: Any = None
+
+
+def _fault(ctx: IncidentContext, stage: str) -> None:
+    if ctx.faults is not None:
+        ctx.faults.at(stage)
 
 
 def _ensure_hypotheses(ctx: IncidentContext) -> list[Hypothesis]:
@@ -107,19 +121,32 @@ def _ensure_action(ctx: IncidentContext) -> RemediationAction | None:
     rows = ctx.db.actions_for(ctx.incident.id)
     if not rows:
         return None
-    r = rows[-1]
+    import json as _json
+    # compensation rows ride the same table under suffixed idempotency
+    # keys — the WORKFLOW action is the newest non-derived row
+    primary = [r for r in rows
+               if ":" not in (r["idempotency_key"] or "")] or rows
+    r = primary[-1]
     ctx.action = RemediationAction(
         id=r["id"], incident_id=r["incident_id"],
         hypothesis_id=r["hypothesis_id"],
         idempotency_key=r["idempotency_key"],
         action_type=r["action_type"], target_resource=r["target_resource"],
         target_namespace=r["target_namespace"],
+        # parameters + execution_result were dropped by the pre-saga
+        # rehydration — compensation needs prev_replicas and the executor
+        # needs the requested replica target, so a replayed context must
+        # carry both
+        parameters=_json.loads(r["parameters"] or "{}"),
         risk_level=r["risk_level"],
         blast_radius_score=r["blast_radius_score"],
         environment=r["environment"], status=ActionStatus(r["status"]),
         status_reason=r["status_reason"],
         requires_approval=bool(r["requires_approval"]),
         approved_by=r["approved_by"],
+        execution_result=(_json.loads(r["execution_result"])
+                          if r["execution_result"] else None),
+        error_message=r["error_message"],
     )
     return ctx.action
 
@@ -127,6 +154,7 @@ def _ensure_action(ctx: IncidentContext) -> RemediationAction | None:
 # -- step implementations (activities.py analogs) --------------------------
 
 def collect_evidence(ctx: IncidentContext) -> dict:
+    _fault(ctx, "collect")
     collectors = default_collectors(ctx.cluster, ctx.settings)
     results = collect_all(ctx.incident, collectors, parallel=True)
     all_ev = [e for r in results for e in r.evidence]
@@ -137,16 +165,34 @@ def collect_evidence(ctx: IncidentContext) -> dict:
         "evidence_count": len(all_ev),
         "collectors": {r.collector_name: r.success for r in results},
         "errors": [err for r in results for err in r.errors],
+        # graft-saga replay fidelity: the collector-emitted graph payload
+        # (topology entities/relations) existed only in memory, so a
+        # crash after this step rebuilt a THINNER graph than the original
+        # run saw — journal it with the step so build_graph's replay
+        # re-ingests the exact same graph (bounded: collectors only emit
+        # this incident's service/namespace neighborhood)
+        "graph": {
+            "entities": [e.model_dump(mode="json")
+                         for r in results for e in r.entities],
+            "relations": [rel.model_dump(mode="json")
+                          for r in results for rel in r.relations],
+        },
     }
 
 
 def build_graph(ctx: IncidentContext) -> dict:
     results = ctx.results.pop("_collector_results", None)
     if results is None:  # replayed run: rebuild from persisted evidence
-        from ..models import CollectorResult, Evidence
+        from ..models import (
+            CollectorResult, Evidence, GraphEntity, GraphRelation)
         evs = [Evidence(**{**row, "data": row["data"]})
                for row in _evidence_rows(ctx)]
-        results = [CollectorResult(collector_name="replay", evidence=evs)]
+        graph = (ctx.results.get("collect_evidence") or {}).get("graph") or {}
+        results = [CollectorResult(
+            collector_name="replay", evidence=evs,
+            entities=[GraphEntity(**d) for d in graph.get("entities", [])],
+            relations=[GraphRelation(**d)
+                       for d in graph.get("relations", [])])]
     stats = ctx.builder.ingest(ctx.incident, results)
     out = {k: v for k, v in stats.items() if k != "incident_node"}
     # graft-surge: feed the webhook's delta batch into the resident
@@ -173,6 +219,16 @@ def _evidence_rows(ctx: IncidentContext) -> list[dict]:
     for r in rows:
         r.setdefault("incident_id", str(ctx.incident.id))
     return rows
+
+
+def _ensure_evidence(ctx: IncidentContext) -> list[dict]:
+    """Rehydrate evidence dicts from storage after a journal replay (the
+    transient ctx.evidence_dicts dies with the crashed worker; runbooks
+    and tickets generated on the resumed run must see the same evidence
+    the original run saw)."""
+    if not ctx.evidence_dicts:
+        ctx.evidence_dicts = _evidence_rows(ctx)
+    return ctx.evidence_dicts
 
 
 def _streaming_hypotheses(ctx: IncidentContext,
@@ -279,16 +335,19 @@ def generate_hypotheses(ctx: IncidentContext) -> dict:
 
 def rank_hypotheses(ctx: IncidentContext) -> dict:
     # ranking is constant-folded into generation (ruleset.py); recorded for
-    # lifecycle parity with activities.py:164-173
-    return {"ranked": [h.rule_id for h in ctx.hypotheses],
-            "top_score": ctx.hypotheses[0].final_score if ctx.hypotheses else None}
+    # lifecycle parity with activities.py:164-173. _ensure_hypotheses, not
+    # ctx.hypotheses: a resume whose crash ate only this step's commit
+    # must re-rank the PERSISTED hypotheses, not an empty transient list.
+    hyps = _ensure_hypotheses(ctx)
+    return {"ranked": [h.rule_id for h in hyps],
+            "top_score": hyps[0].final_score if hyps else None}
 
 
 def generate_runbook(ctx: IncidentContext) -> dict:
     if not _ensure_hypotheses(ctx):
         return {"generated": False}
     rb = RunbookGenerator().generate(ctx.incident, ctx.hypotheses[0],
-                                     evidence=ctx.evidence_dicts)
+                                     evidence=_ensure_evidence(ctx))
     ctx.db.insert_runbook(rb)
     return {"generated": True, "title": rb.title, "steps": len(rb.steps)}
 
@@ -346,6 +405,10 @@ def request_approval(ctx: IncidentContext) -> dict:
         ctx.db.upsert_action(action)
         return {"approved": True, "by": "auto-dev"}
     slack = ctx.slack or SlackClient(ctx.settings)
+    # graft-saga satellite: rehydrate via _ensure_hypotheses — a
+    # resume-after-crash context has empty ctx.hypotheses, and the
+    # approver was being asked to sign off on a blank summary
+    hyps = _ensure_hypotheses(ctx)
     req = ApprovalRequest(
         action_id=action.id, incident_id=ctx.incident.id,
         incident_title=ctx.incident.title, action_type=action.action_type,
@@ -353,7 +416,7 @@ def request_approval(ctx: IncidentContext) -> dict:
         target_namespace=action.target_namespace,
         risk_level=action.risk_level,
         blast_radius_score=action.blast_radius_score,
-        hypothesis_summary=ctx.hypotheses[0].description if ctx.hypotheses else "",
+        hypothesis_summary=hyps[0].description if hyps else "",
     )
     timeout = ctx.settings.approval_timeout_seconds
     resp = slack.request_approval(req, timeout_s=timeout)
@@ -370,36 +433,78 @@ def request_approval(ctx: IncidentContext) -> dict:
 
 
 def execute_remediation(ctx: IncidentContext) -> dict:
+    """graft-saga two-phase execution: the executor journals an intent
+    row (idempotency key + pre-action probe + verification baseline)
+    into the durable ``action_executions`` ledger BEFORE the cluster
+    mutation and a result row after. A crash anywhere in between leaves
+    an in-doubt intent that the resumed run RECONCILES against observed
+    cluster state — the mutation fires exactly once, never twice. The
+    baseline rides the intent row, so a resumed run compares against the
+    true pre-action snapshot instead of re-probing the mutated cluster."""
     action = _ensure_action(ctx)
     assert action is not None
     verifier = RemediationVerifier(ctx.cluster)
-    ctx.baseline = verifier.capture_baseline(ctx.incident)
+    executor = RemediationExecutor(
+        ctx.cluster, ctx.settings, db=ctx.db,
+        fault_hook=(ctx.faults.at if ctx.faults is not None else None))
+    baseline = executor.ledger_baseline(action)
+    if baseline is None:
+        baseline = verifier.capture_baseline(ctx.incident)
+    ctx.baseline = baseline
     REMEDIATION_ATTEMPTS.inc(action_type=action.action_type.value)
-    executed = RemediationExecutor(ctx.cluster, ctx.settings).execute(action)
+    executed = executor.execute(action, baseline=baseline)
     ctx.db.upsert_action(executed)
     return {"status": executed.status.value,
             "result": executed.execution_result,
             "error": executed.error_message,
-            "baseline": ctx.baseline}  # journaled: survives resume
+            "baseline": baseline}  # journaled: survives resume
 
 
 async def verify_remediation(ctx: IncidentContext) -> dict:
+    action = _ensure_action(ctx)
+    if action is None:
+        # graft-saga satellite: a replay whose actions table lost its row
+        # (foreign journal, manual surgery) used to crash the verifier —
+        # journal a SKIPPED verification instead: success=None is neither
+        # the ticket trigger (False) nor a resolved close (True)
+        log.warning("verify_skipped_no_action",
+                    incident=str(ctx.incident.id))
+        return {"success": None, "skipped": "no persisted action"}
+    _fault(ctx, "verify")
     await asyncio.sleep(min(ctx.settings.verification_wait_seconds, 120))
     verifier = RemediationVerifier(ctx.cluster)
     baseline = ctx.baseline or (
         ctx.results.get("execute_remediation") or {}).get("baseline") or {}
-    result = verifier.verify(ctx.incident, _ensure_action(ctx), baseline)
+    result = verifier.verify(ctx.incident, action, baseline)
     ctx.db.insert_verification(result)
     return {"success": result.success,
             "metrics_improved": result.metrics_improved,
             "pods_healthy_after": result.pods_healthy_after}
 
 
+def compensate_remediation(ctx: IncidentContext) -> dict:
+    """graft-saga compensation: verification FAILED on an executed
+    action — roll its cluster effect back (scale → restore the
+    pre-action replica count, cordon → uncordon, rollback →
+    re-rollback; restart-class self-heals), policy-gated and journaled,
+    with bounded attempts and escalate-to-human on exhaustion. Runs
+    through the same two-phase ledger, so a crash mid-compensation
+    reconciles on resume instead of double-firing."""
+    action = _ensure_action(ctx)
+    if action is None:
+        return {"compensated": False, "skipped": "no persisted action"}
+    _fault(ctx, "compensate")
+    comp = RemediationCompensator(
+        ctx.cluster, ctx.settings, db=ctx.db,
+        fault_hook=(ctx.faults.at if ctx.faults is not None else None))
+    return comp.compensate(action)
+
+
 def create_ticket(ctx: IncidentContext) -> dict:
     jira = ctx.jira or JiraClient(ctx.settings)
     hyps = _ensure_hypotheses(ctx)
     return jira.create_incident_ticket(ctx.incident, hyps[0] if hyps else None,
-                                       evidence=ctx.evidence_dicts)
+                                       evidence=_ensure_evidence(ctx))
 
 
 def close_incident(ctx: IncidentContext) -> dict:
@@ -433,13 +538,26 @@ def _needs_ticket(ctx: IncidentContext) -> bool:
             or verify.get("success") is False)  # incident_workflow.py:246-250
 
 
-# canonical step order for inspection surfaces (the 12-step lifecycle);
-# kept in sync with incident_steps() below
+def _compensation_due(ctx: IncidentContext) -> bool:
+    """Saga trigger: the action EXECUTED but verification said the
+    cluster did not get better — undo the mutation before ticketing."""
+    if not getattr(ctx.settings, "remediation_compensation", False):
+        return False
+    execute = ctx.results.get("execute_remediation") or {}
+    verify = ctx.results.get("verify_remediation") or {}
+    return (execute.get("status") == "completed"
+            and verify.get("success") is False)
+
+
+# canonical step order for inspection surfaces (the 13-step lifecycle:
+# the reference's 12 + the graft-saga compensation step); kept in sync
+# with incident_steps() below
 STEP_NAMES = (
     "collect_evidence", "build_graph", "generate_hypotheses",
     "rank_hypotheses", "generate_runbook", "calculate_blast_radius",
     "evaluate_policy", "request_approval", "execute_remediation",
-    "verify_remediation", "create_ticket", "close_incident",
+    "verify_remediation", "compensate_remediation", "create_ticket",
+    "close_incident",
 )
 
 
@@ -464,6 +582,8 @@ def incident_steps(settings: Settings | None = None) -> list[Step]:
              timeout_s=s.verification_wait_seconds + 120,
              condition=lambda ctx: (ctx.results.get("execute_remediation") or {}
                                     ).get("status") == "completed"),
+        Step("compensate_remediation", compensate_remediation, timeout_s=300,
+             condition=_compensation_due),
         Step("create_ticket", create_ticket, timeout_s=30,
              condition=_needs_ticket),
         Step("close_incident", close_incident, timeout_s=30),
@@ -482,23 +602,73 @@ async def run_incident_workflow(
     dedup: Any = None,
     scorer: Any = None,
     tenant: str = "default",
+    faults: Any = None,
 ) -> dict:
     """Entry point: the reference's `start_workflow("IncidentWorkflow",
-    id=f"incident-{id}")` (main.py:406-413)."""
+    id=f"incident-{id}")` (main.py:406-413).
+
+    graft-saga: the run claims a fenced lease on the workflow id before
+    touching the incident. A held lease means another worker is live on
+    this workflow — return without driving it. A crash (worker death)
+    leaves the lease to EXPIRE, at which point the resumer sweep
+    (worker.resume_orphans) reclaims it and re-enters here through the
+    journal-replay path; the fencing token keeps a paused-then-woken
+    zombie from double-driving the journal."""
     s = settings or get_settings()
     ctx = IncidentContext(
         incident=incident, cluster=cluster, db=db,
         builder=builder or GraphBuilder(), settings=s,
         slack=slack, jira=jira, dedup=dedup, scorer=scorer,
-        tenant=tenant,
+        tenant=tenant, faults=faults,
     )
     engine = engine or WorkflowEngine(db)
+    wf_id = f"incident-{incident.id}"
+    lease = None
+    ttl = float(getattr(s, "workflow_lease_ttl_s", 60.0))
+    if getattr(s, "workflow_lease_enabled", False):
+        import os
+        from uuid import uuid4 as _uuid4
+        owner = f"{os.getpid()}:{_uuid4().hex[:8]}"
+        token = db.lease_acquire(wf_id, owner, ttl)
+        if token is None:
+            log.info("workflow_lease_held", workflow=wf_id)
+            return {"lease_held": True}
+        lease = (owner, token)
+        if token > 1:
+            _fault(ctx, "crash_restart")  # chaos: die again right away
     db.update_incident_status(incident.id, IncidentStatus.INVESTIGATING)
+    released_ok = False
     try:
-        results = await engine.run(f"incident-{incident.id}",
-                                   incident_steps(s), ctx)
+        results = await engine.run(wf_id, incident_steps(s), ctx,
+                                   lease=lease, lease_ttl_s=ttl)
+        released_ok = True
+        return results
+    except WorkflowFenced:
+        # benign: the lease expired mid-run and another worker owns the
+        # workflow now — do NOT audit a failure, do NOT release (the
+        # owner+token match makes a late release a no-op anyway)
+        log.warning("workflow_fenced_out", workflow=wf_id)
+        return {"lease_fenced": True}
+    except StepFailed as exc:
+        log.error("workflow_failed", incident=str(incident.id), error=str(exc))
+        db.audit(str(incident.id), "workflow_failed", {"error": str(exc)})
+        # graft-saga satellite: a StepFailed leaves the incident open with
+        # only an audit row — stamp the stalled gauge so the resumer sweep
+        # and GET /api/v1/workflows surface it instead of it vanishing
+        # into INVESTIGATING forever
+        released_ok = True
+        from ..observability import metrics as obs_metrics
+        obs_metrics.WORKFLOW_STALLED.set(float(len(db.stalled_workflows(
+            max_resumes=int(getattr(s, "workflow_max_resumes", 5))))))
+        raise
     except Exception as exc:
         log.error("workflow_failed", incident=str(incident.id), error=str(exc))
         db.audit(str(incident.id), "workflow_failed", {"error": str(exc)})
+        released_ok = True
         raise
-    return results
+    finally:
+        # a CRASH (BaseException, e.g. rca/faults.WorkflowCrash) skips the
+        # release on purpose — a dead worker cannot release, the lease
+        # must EXPIRE into the resumer's hands
+        if lease is not None and released_ok:
+            db.lease_release(wf_id, *lease)

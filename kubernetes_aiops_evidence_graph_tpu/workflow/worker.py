@@ -15,17 +15,35 @@ from __future__ import annotations
 
 import asyncio
 import threading
+from sqlite3 import Error as sqlite3Error
 from typing import Any
 
 from ..config import Settings, get_settings
 from ..graph import GraphBuilder
 from ..models import Incident
 from ..observability import get_logger
+from ..observability import metrics as obs_metrics
 from ..storage import Database
 from .engine import WorkflowEngine
 from .incident_workflow import run_incident_workflow
 
 log = get_logger("worker")
+
+
+def _incident_from_row(row: dict) -> Incident:
+    """Rehydrate an Incident from its durable incidents row (the resumer
+    re-enters run_incident_workflow with it; pydantic coerces the ISO
+    strings and enum values)."""
+    return Incident(
+        id=row["id"], fingerprint=row["fingerprint"], title=row["title"],
+        description=row["description"], severity=row["severity"],
+        status=row["status"], source=row["source"], cluster=row["cluster"],
+        namespace=row["namespace"], service=row["service"],
+        labels=row.get("labels") or {},
+        annotations=row.get("annotations") or {},
+        started_at=row["started_at"], created_at=row["created_at"],
+        updated_at=row["updated_at"],
+    )
 
 
 class IncidentWorker:
@@ -77,6 +95,10 @@ class IncidentWorker:
         # path so tests can pin the fast path actually engages
         self._scorer_resolved = False
         self.scorer_resolutions = 0
+        # graft-saga resumer: the periodic sweep task reclaiming expired
+        # leases (started by start() when workflow_resume_interval_s > 0)
+        self._resume_task: asyncio.Task | None = None
+        self.resumed: int = 0
 
     def serving_scorer(self) -> Any:
         """Lazily build the shared resident scorer: StreamingScorer for
@@ -224,6 +246,38 @@ class IncidentWorker:
             finally:
                 self.queue.task_done()
 
+    # -- graft-saga resumer: drain orphaned workflows ---------------------
+
+    async def resume_orphans(self) -> int:
+        """One sweep: reclaim workflows whose lease EXPIRED (their worker
+        died mid-run) and re-enter them through run_incident_workflow's
+        journal-replay path. Also stamps the stalled-workflow gauge
+        (failed steps / exhausted resume budget) so operators see what
+        the sweep will NOT touch."""
+        if not getattr(self.settings, "workflow_lease_enabled", False):
+            return 0
+        max_resumes = int(getattr(self.settings, "workflow_max_resumes", 5))
+        resumed = 0
+        for row in self.db.orphaned_incidents(max_resumes=max_resumes):
+            incident = _incident_from_row(row)
+            obs_metrics.WORKFLOW_RESUMES.inc()
+            self.resumed += 1
+            resumed += 1
+            log.info("workflow_resumed", incident=str(incident.id),
+                     prior_resumes=row.get("resumes"))
+            await self.submit(incident)
+        obs_metrics.WORKFLOW_STALLED.set(float(len(
+            self.db.stalled_workflows(max_resumes=max_resumes))))
+        return resumed
+
+    async def _resume_loop(self, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                await self.resume_orphans()
+            except (sqlite3Error, RuntimeError, ValueError) as exc:
+                log.error("resume_sweep_failed", error=str(exc))
+
     async def start(self) -> None:
         if self.scorer is not None:
             # a prior drain() stopped the warms; serving is resuming, so
@@ -232,9 +286,18 @@ class IncidentWorker:
             self.scorer._rearm_warm_growth()
         self._tasks = [asyncio.create_task(self._worker_loop(i))
                        for i in range(self.concurrency)]
+        interval = float(getattr(self.settings,
+                                 "workflow_resume_interval_s", 0.0))
+        if interval > 0 and getattr(self.settings,
+                                    "workflow_lease_enabled", False):
+            self._resume_task = asyncio.create_task(
+                self._resume_loop(interval))
 
     async def drain(self) -> None:
         """Wait for queue to empty, then stop workers."""
+        if self._resume_task is not None:
+            self._resume_task.cancel()
+            self._resume_task = None
         await self.queue.join()
         for _ in self._tasks:
             await self.queue.put(None)

@@ -1,0 +1,626 @@
+"""graft-saga: durable exactly-once remediation.
+
+Covers the verdict→closed-incident back half of the lifecycle:
+
+* two-phase action execution against the ``action_executions`` ledger —
+  intent before the cluster mutation, result after, in-doubt intents
+  RECONCILED by probing cluster state (never blindly re-fired)
+* workflow leases + fencing (two workers never double-drive one
+  workflow) and the resumer sweep that drains orphaned workflows
+* saga compensation: a failed verification rolls the action's cluster
+  effect back (scale → prior replicas, cordon → uncordon, rollback →
+  re-rollback), bounded attempts, escalate-to-human
+* lifecycle chaos: seeded crashes at every stage boundary — including
+  between the cluster mutation and the journal commit — must yield ZERO
+  duplicate cluster mutations (counted at the MutationRecorder backend
+  seam) and a final incident/action/journal state identical to an
+  unfaulted run.
+"""
+import asyncio
+import json
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.models import (
+    ActionStatus, ActionType, RemediationAction,
+)
+from kubernetes_aiops_evidence_graph_tpu.rca.faults import (
+    WORKFLOW_STAGES, Fault, FaultInjector, MutationRecorder, WorkflowCrash,
+)
+from kubernetes_aiops_evidence_graph_tpu.remediation import (
+    RemediationCompensator, RemediationExecutor, RemediationOrchestrator,
+    RemediationVerifier,
+)
+from kubernetes_aiops_evidence_graph_tpu.simulator import (
+    generate_cluster, inject,
+)
+from kubernetes_aiops_evidence_graph_tpu.storage import Database
+from kubernetes_aiops_evidence_graph_tpu.workflow import (
+    IncidentWorker, Step, StepFailed, WorkflowEngine, WorkflowFenced,
+    run_incident_workflow,
+)
+
+SAGA = load_settings(
+    app_env="development", remediation_dry_run=False,
+    verification_wait_seconds=0, rca_backend="cpu",
+    workflow_lease_enabled=True, workflow_lease_ttl_s=0.05,
+    workflow_resume_interval_s=0.0,
+    node_bucket_sizes=(512, 2048), edge_bucket_sizes=(2048, 8192),
+    incident_bucket_sizes=(8, 32),
+)
+
+
+def _run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+def _world(scenario="crashloop_deploy", seed=9, num_pods=60):
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    target = sorted(cluster.deployments)[0]
+    incident = inject(cluster, scenario, target, np.random.default_rng(seed))
+    db = Database(":memory:")
+    db.create_incident(incident)
+    return cluster, target, incident, db
+
+
+# ---------------------------------------------------------------------------
+# two-phase ledger
+# ---------------------------------------------------------------------------
+
+def test_ledger_intent_before_dispatch_and_result_after():
+    cluster, target, incident, db = _world("crashloop_deploy")
+    rec = MutationRecorder(cluster)
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "rollback_deployment",
+                                 incident.service)
+    ex = RemediationExecutor(rec, SAGA, db=db)
+    out = ex.execute(action, baseline={"error_rate": 1.0})
+    assert out.status == ActionStatus.COMPLETED
+    state = db.execution_state(action.idempotency_key)
+    assert state["intent"] is not None and state["result"] is not None
+    assert state["intent"]["detail"]["baseline"] == {"error_rate": 1.0}
+    assert state["intent"]["detail"]["pre"]["revision"] is not None
+    assert state["result"]["status"] == "completed"
+    # replay: the SAME key answers from the ledger, zero extra mutations
+    n = len(rec.calls)
+    again = RemediationExecutor(rec, SAGA, db=db).execute(action)
+    assert again.status == ActionStatus.COMPLETED
+    assert again.status_reason == "replayed from action ledger"
+    assert len(rec.calls) == n and not rec.duplicates()
+
+
+def test_in_doubt_intent_reconciles_landed_without_refire():
+    """Crash between the cluster mutation and the ledger commit: the
+    resumed executor must probe, see the rollback landed, and record a
+    completed result WITHOUT re-firing."""
+    cluster, target, incident, db = _world("crashloop_deploy")
+    rec = MutationRecorder(cluster)
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "rollback_deployment",
+                                 incident.service)
+    inj = FaultInjector([Fault(stage="wf_execute", at=0, kind="crash")])
+    ex = RemediationExecutor(rec, SAGA, db=db, fault_hook=inj.at)
+    with pytest.raises(WorkflowCrash):
+        ex.execute(action, baseline={})
+    # the mutation landed, the result row did not
+    assert len(rec.calls) == 1
+    assert db.execution_state(action.idempotency_key)["result"] is None
+    assert db.in_doubt_executions()
+
+    resumed = RemediationExecutor(rec, SAGA, db=db)
+    out = resumed.execute(action)
+    assert out.status == ActionStatus.COMPLETED
+    assert out.status_reason == "reconciled: mutation had landed"
+    assert resumed.reconciliations == 1
+    assert len(rec.calls) == 1 and not rec.duplicates()
+    rec2 = db.execution_state(action.idempotency_key)["result"]
+    assert rec2["detail"]["reconciled"] == "landed"
+
+
+def test_in_doubt_intent_refires_when_mutation_never_landed():
+    """Intent journaled but the crash hit BEFORE the dispatch: the probe
+    proves nothing landed and the reconcile re-fires exactly once."""
+    cluster, target, incident, db = _world("crashloop_deploy")
+    rec = MutationRecorder(cluster)
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "rollback_deployment",
+                                 incident.service)
+    pre_rev = cluster.deployments[target].revision
+    db.execution_intent(action.idempotency_key, str(action.id),
+                        str(action.incident_id), action.action_type.value,
+                        {"pre": {"revision": pre_rev,
+                                 "replicas": cluster.deployments[target].replicas,
+                                 "image": cluster.deployments[target].image},
+                         "baseline": {}})
+    out = RemediationExecutor(rec, SAGA, db=db).execute(action)
+    assert out.status == ActionStatus.COMPLETED
+    assert len(rec.calls) == 1 and not rec.duplicates()
+    res = db.execution_state(action.idempotency_key)["result"]
+    assert res["detail"]["reconciled"] == "refired"
+    assert cluster.deployments[target].revision == pre_rev + 1
+
+
+def test_scale_clamped_and_prev_replicas_recorded():
+    cluster, target, incident, db = _world("hpa_maxed")
+    prev = cluster.deployments[target].replicas
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "scale_replicas",
+                                 incident.service)
+    out = RemediationExecutor(cluster, SAGA, db=db).execute(action)
+    assert out.status == ActionStatus.COMPLETED
+    assert out.execution_result["prev_replicas"] == prev
+    assert out.execution_result["replicas"] == min(
+        prev + 1, SAGA.remediation_max_scale_replicas)
+
+    # the clamp binds: a tight cap refuses to walk replicas past it
+    capped = load_settings(**{**SAGA.__dict__,
+                              "remediation_max_scale_replicas": prev})
+    action2 = orch.propose_action(incident, "scale_replicas",
+                                  incident.service)
+    action2.idempotency_key += ":capped"
+    out2 = RemediationExecutor(cluster, capped, db=db).execute(action2)
+    assert out2.execution_result["replicas"] == prev  # not prev+1
+    assert cluster.deployments[target].replicas <= max(
+        prev + 1, SAGA.remediation_max_scale_replicas)
+
+
+# ---------------------------------------------------------------------------
+# leases, fencing, resumer
+# ---------------------------------------------------------------------------
+
+def test_lease_acquire_heartbeat_fence_release():
+    db = Database(":memory:")
+    t0 = time.time()
+    tok_a = db.lease_acquire("wf-x", "worker-a", 10.0, now=t0)
+    assert tok_a == 1
+    assert db.lease_acquire("wf-x", "worker-b", 10.0, now=t0 + 1) is None
+    assert db.lease_heartbeat("wf-x", "worker-a", tok_a, 10.0, now=t0 + 2)
+    # expiry: b reclaims, token fences a out
+    tok_b = db.lease_acquire("wf-x", "worker-b", 10.0, now=t0 + 13)
+    assert tok_b == 2
+    assert not db.lease_heartbeat("wf-x", "worker-a", tok_a, 10.0)
+    assert db.lease_view("wf-x")["owner"] == "worker-b"
+    # release clears the claim but keeps the token (resume counter)
+    assert db.lease_release("wf-x", "worker-b", tok_b)
+    v = db.lease_view("wf-x")
+    assert v["owner"] is None and v["deadline"] is None and v["token"] == 2
+    # a fenced zombie's late release is a no-op
+    assert not db.lease_release("wf-x", "worker-a", tok_a)
+    db.close()
+
+
+def test_engine_fences_stolen_lease():
+    db = Database(":memory:")
+    engine = WorkflowEngine(db)
+    tok = db.lease_acquire("wf-f", "loser", 30.0)
+    db.lease_acquire("wf-f", "winner", 30.0,
+                     now=time.time() + 60)  # steal via expiry
+    ctx = SimpleNamespace(results={})
+    with pytest.raises(WorkflowFenced):
+        _run(engine.run("wf-f", [Step("s1", lambda c: {"ok": 1})], ctx,
+                        lease=("loser", tok), lease_ttl_s=30.0))
+    # the winner's journal never saw the loser's step
+    assert db.journal_get("wf-f") == {}
+    db.close()
+
+
+def test_concurrent_runs_one_drives_one_yields():
+    cluster, target, incident, db = _world()
+    rec = MutationRecorder(cluster)
+
+    async def both():
+        return await asyncio.gather(
+            run_incident_workflow(incident, rec, db, settings=SAGA),
+            run_incident_workflow(incident, rec, db, settings=SAGA),
+        )
+
+    r1, r2 = _run(both())
+    held = [r for r in (r1, r2) if r.get("lease_held")]
+    done = [r for r in (r1, r2) if not r.get("lease_held")]
+    assert len(held) == 1 and len(done) == 1
+    assert done[0]["close_incident"]["status"] == "resolved"
+    assert not rec.duplicates()
+    db.close()
+
+
+def test_resumer_drains_orphaned_workflow():
+    """Crash a workflow mid-run (worker death), let the lease expire,
+    and prove the worker's startup sweep reclaims it and drives the
+    incident to a verified close through the journal-replay path."""
+    cluster, target, incident, db = _world()
+    inj = FaultInjector([Fault(stage="wf_execute", at=0, kind="crash")])
+    with pytest.raises(WorkflowCrash):
+        _run(run_incident_workflow(incident, cluster, db, settings=SAGA,
+                                   faults=inj))
+    lease = db.lease_view(f"incident-{incident.id}")
+    assert lease["owner"] is not None  # a dead worker cannot release
+    assert db.get_incident(incident.id)["status"] == "investigating"
+    time.sleep(0.08)  # ttl 0.05 — the orphan's lease expires
+
+    async def sweep():
+        worker = IncidentWorker(cluster, db, settings=SAGA, concurrency=1)
+        await worker.start()
+        n = await worker.resume_orphans()
+        await worker.drain()
+        return n, worker.resumed
+
+    n, resumed = _run(sweep())
+    assert n == 1 and resumed == 1
+    assert db.get_incident(incident.id)["status"] == "resolved"
+    # exactly-once: the in-doubt rollback was reconciled, not re-fired
+    assert db.execution_state(
+        db.actions_for(incident.id)[0]["idempotency_key"]
+    )["result"]["detail"].get("reconciled") == "landed"
+    db.close()
+
+
+def test_stalled_workflow_surfaced_not_resumed():
+    """A StepFailed workflow releases its lease and is STALLED (operator
+    surface), never auto-resumed by the sweep."""
+    cluster, target, incident, db = _world()
+
+    def boom(ctx):
+        raise ValueError("deterministic failure")  # non-retryable
+
+    from kubernetes_aiops_evidence_graph_tpu.models import IncidentStatus
+    engine = WorkflowEngine(db)
+    wf_id = f"incident-{incident.id}"
+    db.update_incident_status(incident.id, IncidentStatus.INVESTIGATING)
+    with pytest.raises(StepFailed):
+        _run(engine.run(wf_id, [Step("bad", boom)],
+                        SimpleNamespace(results={})))
+    stalled = db.stalled_workflows()
+    assert [s["workflow_id"] for s in stalled] == [wf_id]
+    assert stalled[0]["reason"] == "step_failed"
+    assert db.orphaned_incidents() == []          # the sweep skips it
+    st = engine.status(wf_id)
+    assert st["stalled"] and st["failed"] == ["bad"]
+    db.close()
+
+
+def test_engine_sync_step_timeout_counts_orphan():
+    from kubernetes_aiops_evidence_graph_tpu.observability.metrics import (
+        WORKFLOW_STEP_ORPHANS)
+    from kubernetes_aiops_evidence_graph_tpu.workflow.engine import RetryPolicy
+    db = Database(":memory:")
+    engine = WorkflowEngine(db)
+
+    def sleepy(ctx):
+        time.sleep(0.4)
+        return {"late": True}
+
+    before = WORKFLOW_STEP_ORPHANS.value(step="sleepy")
+    with pytest.raises(StepFailed):
+        _run(engine.run("wf-orphan", [
+            Step("sleepy", sleepy, timeout_s=0.05,
+                 retry=RetryPolicy(max_attempts=1))],
+            SimpleNamespace(results={})))
+    assert WORKFLOW_STEP_ORPHANS.value(step="sleepy") == before + 1
+    db.close()
+
+
+def test_request_approval_replay_rehydrates_hypothesis_summary():
+    """Satellite: resume-after-crash used to send an EMPTY hypothesis
+    summary to the approver (ctx.hypotheses is transient)."""
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.workflow import incident_steps
+    from kubernetes_aiops_evidence_graph_tpu.workflow.incident_workflow import (
+        IncidentContext)
+
+    approval = load_settings(**{**SAGA.__dict__,
+                                "remediation_auto_approve_dev": False,
+                                "approval_timeout_seconds": 1})
+    cluster, target, incident, db = _world()
+    steps = incident_steps(approval)
+    idx = next(i for i, s in enumerate(steps)
+               if s.name == "request_approval")
+    engine = WorkflowEngine(db)
+    ctx1 = IncidentContext(incident=incident, cluster=cluster, db=db,
+                           builder=GraphBuilder(), settings=approval)
+    _run(engine.run(f"incident-{incident.id}", steps[:idx], ctx1))
+
+    captured = {}
+
+    class StubSlack:
+        def request_approval(self, req, timeout_s=0):
+            captured["summary"] = req.hypothesis_summary
+            return SimpleNamespace(approved=True, responder="op",
+                                   notes=None)
+
+    # fresh context — transient hypotheses lost, as after a crash
+    results = _run(run_incident_workflow(
+        incident, cluster, db, settings=approval, engine=engine,
+        slack=StubSlack()))
+    assert results["request_approval"]["approved"] is True
+    assert captured["summary"], "approver saw an empty hypothesis summary"
+    db.close()
+
+
+def test_verify_without_persisted_action_journals_skip():
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.workflow.incident_workflow import (
+        IncidentContext, verify_remediation)
+    cluster, target, incident, db = _world()
+    ctx = IncidentContext(incident=incident, cluster=cluster, db=db,
+                          builder=GraphBuilder(), settings=SAGA)
+    ctx.results["execute_remediation"] = {"status": "completed"}
+    out = _run(verify_remediation(ctx))
+    assert out == {"success": None, "skipped": "no persisted action"}
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# saga compensation
+# ---------------------------------------------------------------------------
+
+def test_compensation_scale_restores_prev_replicas():
+    cluster, target, incident, db = _world("hpa_maxed")
+    prev = cluster.deployments[target].replicas
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "scale_replicas",
+                                 incident.service)
+    executed = RemediationExecutor(cluster, SAGA, db=db).execute(action)
+    assert cluster.deployments[target].replicas == prev + 1
+    out = RemediationCompensator(cluster, SAGA, db=db).compensate(executed)
+    assert out["compensated"] is True
+    assert cluster.deployments[target].replicas == prev
+    rows = {r["idempotency_key"]: r for r in db.actions_for(incident.id)}
+    assert rows[action.idempotency_key]["status"] == "rolled_back"
+    assert rows[action.idempotency_key + ":comp"]["status"] == "completed"
+
+
+def test_compensation_cordon_uncordons():
+    cluster, target, incident, db = _world()
+    node = sorted(cluster.nodes)[0]
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "cordon_node", node)
+    executed = RemediationExecutor(cluster, SAGA, db=db).execute(action)
+    assert cluster.nodes[node].conditions.get("Unschedulable") == "True"
+    out = RemediationCompensator(cluster, SAGA, db=db).compensate(executed)
+    assert out["compensated"] is True
+    assert cluster.nodes[node].conditions.get("Unschedulable") != "True"
+
+
+def test_compensation_restart_class_is_noop():
+    cluster, target, incident, db = _world("oom")
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "restart_deployment",
+                                 incident.service)
+    executed = RemediationExecutor(cluster, SAGA, db=db).execute(action)
+    rec = MutationRecorder(cluster)
+    out = RemediationCompensator(rec, SAGA, db=db).compensate(executed)
+    assert out["noop"] is True and not rec.calls
+
+
+def test_compensation_bounded_attempts_then_escalates(monkeypatch):
+    cluster, target, incident, db = _world("hpa_maxed")
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "scale_replicas",
+                                 incident.service)
+    executed = RemediationExecutor(cluster, SAGA, db=db).execute(action)
+    monkeypatch.setattr(type(cluster), "scale_deployment",
+                        lambda self, ns, d, r: False)
+    out = RemediationCompensator(cluster, SAGA, db=db).compensate(executed)
+    assert out["compensated"] is False and out["escalated"] is True
+    assert out["attempts"] == SAGA.remediation_compensation_attempts
+    esc = [r for r in db.actions_for(incident.id)
+           if r["action_type"] == "escalate_to_human"]
+    assert len(esc) == 1 and esc[0]["status"] == "pending_approval"
+    events = [a["event"] for a in db.audit_for(str(incident.id))]
+    assert "compensation_escalated" in events
+
+
+def test_compensation_policy_denied_escalates_without_mutation():
+    prod = load_settings(**{**SAGA.__dict__, "app_env": "production"})
+    cluster, target, incident, db = _world()
+    orch = RemediationOrchestrator(cluster, SAGA)
+    action = orch.propose_action(incident, "rollback_deployment",
+                                 incident.service)
+    action.execution_result = {"ok": True, "rolled_back": incident.service}
+    action.status = ActionStatus.COMPLETED
+    rec = MutationRecorder(cluster)
+    out = RemediationCompensator(rec, prod, db=db).compensate(action)
+    assert out["denied"] is True and out["escalated"] is True
+    assert not rec.calls  # the gate held: nothing mutated
+
+
+def test_workflow_failed_verification_compensates_end_to_end(monkeypatch):
+    """Lifecycle: rollback executes, verification FAILS, the saga
+    re-rollbacks (restoring the pre-action image), the original action is
+    marked rolled_back, a ticket files, the incident closes."""
+    from kubernetes_aiops_evidence_graph_tpu.models import VerificationResult
+
+    def failing_verify(self, incident, action, baseline=None):
+        return VerificationResult(
+            action_id=action.id, incident_id=incident.id, success=False,
+            metrics_improved=False)
+
+    monkeypatch.setattr(RemediationVerifier, "verify", failing_verify)
+    cluster, target, incident, db = _world("crashloop_deploy")
+    image_before = cluster.deployments[target].image    # the bad :v2
+    results = _run(run_incident_workflow(incident, cluster, db,
+                                         settings=SAGA))
+    assert results["execute_remediation"]["status"] == "completed"
+    assert results["verify_remediation"]["success"] is False
+    assert results["compensate_remediation"]["compensated"] is True
+    # the compensation re-rolled the deployment back to its pre-action
+    # template (the forward rollback had swapped :v2 -> :v1)
+    assert cluster.deployments[target].image == image_before
+    assert results["create_ticket"]["queued"] is True
+    assert results["close_incident"]["status"] == "closed"
+    rows = {r["idempotency_key"]: r for r in db.actions_for(incident.id)}
+    orig = [r for k, r in rows.items() if ":" not in k]
+    assert orig[0]["status"] == "rolled_back"
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle chaos: crash at every stage boundary, exactly-once + parity
+# ---------------------------------------------------------------------------
+
+_TS_RE = r"\d{4}-\d{2}-\d{2}T[0-9:.]+(?:\+00:00|Z)?"
+
+
+def _scrub(text, incident):
+    """Two twin worlds differ ONLY in uuids and wall-clock timestamps —
+    scrub both so everything else must match bit-for-bit."""
+    import re
+    return re.sub(_TS_RE, "<ts>", text.replace(str(incident.id), "<id>"))
+
+
+def _normalize_journal(db, incident):
+    out = {}
+    for step, e in db.journal_get(f"incident-{incident.id}").items():
+        res = json.dumps(e["result"], sort_keys=True, default=str)
+        out[step] = (e["status"], _scrub(res, incident))
+    return out
+
+
+def _normalize_actions(db, incident):
+    import re
+    rows = []
+    for r in db.actions_for(incident.id):
+        # strip the per-world incident uuid and the YYYYMMDDHH component
+        # (two arms launched across an hour boundary must still agree)
+        key = re.sub(r"_\d{10}", "", _scrub(r["idempotency_key"], incident))
+        rows.append((key, r["action_type"], r["status"],
+                     _scrub(r["execution_result"] or "", incident),
+                     r["error_message"]))
+    return sorted(rows)
+
+
+def _drive_lifecycle(scenario, seed, faults=None, settings=SAGA,
+                     max_cycles=40):
+    """Run one incident webhook→close, resuming through the journal-
+    replay path after every injected WorkflowCrash — the in-process
+    analog of a worker being SIGKILLed and a fresh one picking the
+    workflow up after the lease expires."""
+    cluster, target, incident, db = _world(scenario, seed)
+    rec = MutationRecorder(cluster)
+    inj = FaultInjector(faults or [])
+    resumes = 0
+    results = None
+    for _ in range(max_cycles):
+        try:
+            results = _run(run_incident_workflow(
+                incident, rec, db, settings=settings, faults=inj))
+        except WorkflowCrash:
+            resumes += 1
+            time.sleep(0.08)            # let the dead run's lease expire
+            continue
+        break
+    assert results is not None and "close_incident" in results, \
+        f"lifecycle never completed after {resumes} resumes"
+    return SimpleNamespace(
+        cluster=cluster, target=target, incident=incident, db=db, rec=rec,
+        results=results, resumes=resumes,
+        journal=_normalize_journal(db, incident),
+        actions=_normalize_actions(db, incident),
+        status=db.get_incident(incident.id)["status"],
+        fired=list(inj.fired),
+    )
+
+
+def _assert_parity(faulted, clean):
+    # "zero duplicate mutations" formally: no (method, args) fires more
+    # times than in the unfaulted twin (a saga re-rollback legitimately
+    # repeats the forward rollback's signature — in BOTH arms)
+    from collections import Counter
+    extra = Counter(faulted.rec.calls) - Counter(clean.rec.calls)
+    assert not extra, f"duplicate cluster mutations: {dict(extra)}"
+    assert faulted.rec.calls == clean.rec.calls
+    assert faulted.status == clean.status
+    assert faulted.journal == clean.journal
+    assert faulted.actions == clean.actions
+
+
+@pytest.mark.fault_injection
+@pytest.mark.parametrize("scenario", ["crashloop_deploy", "oom"])
+@pytest.mark.parametrize("stage", ["collect", "wf_execute", "verify",
+                                   "crash_restart"])
+def test_workflow_chaos_crash_at_stage_boundary(scenario, stage):
+    clean = _drive_lifecycle(scenario, seed=9)
+    faults = [Fault(stage=stage, at=0, kind="crash")]
+    if stage == "crash_restart":
+        # crash_restart only fires on a RESUMED run — seed a first crash
+        faults = [Fault(stage="collect", at=0, kind="crash")] + faults
+    faulted = _drive_lifecycle(scenario, seed=9, faults=faults)
+    assert faulted.resumes >= 1 and faulted.fired
+    _assert_parity(faulted, clean)
+
+
+@pytest.mark.fault_injection
+def test_workflow_chaos_crash_at_every_journal_commit():
+    """Kill the worker between EVERY step's effects and its journal
+    commit — the lost-commit window. Each boundary must replay to a
+    bit-identical final state with zero duplicate mutations."""
+    clean = _drive_lifecycle("crashloop_deploy", seed=9)
+    boundaries = len([s for s, (st, _) in clean.journal.items()
+                      if st == "completed"])
+    assert boundaries >= 10
+    for at in range(boundaries):
+        faulted = _drive_lifecycle(
+            "crashloop_deploy", seed=9,
+            faults=[Fault(stage="journal_put", at=at, kind="crash")])
+        assert faulted.resumes == 1, f"boundary {at}"
+        _assert_parity(faulted, clean)
+
+
+@pytest.mark.fault_injection
+def test_workflow_chaos_randomized_sweep():
+    """Seeded multi-crash schedules across ALL lifecycle stages (the CI
+    chaos job re-rolls the seed per run and echoes it)."""
+    import os
+    seed = int(os.environ.get("KAEG_CHAOS_SEED", "0"))
+    clean = _drive_lifecycle("crashloop_deploy", seed=9)
+    for round_ in range(3):
+        inj = FaultInjector.seeded(seed + round_, ticks=2, rate=0.4,
+                                   stages=WORKFLOW_STAGES)
+        faulted = _drive_lifecycle("crashloop_deploy", seed=9,
+                                   faults=inj.faults)
+        _assert_parity(faulted, clean)
+    print(f"\nchaos sweep seed={seed} ok")
+
+
+@pytest.mark.fault_injection
+def test_workflow_chaos_compensation_boundary(monkeypatch):
+    """Crash inside the compensation step: the comp mutation must stay
+    exactly-once through its own ledger key."""
+    from kubernetes_aiops_evidence_graph_tpu.models import VerificationResult
+
+    def failing_verify(self, incident, action, baseline=None):
+        return VerificationResult(
+            action_id=action.id, incident_id=incident.id, success=False,
+            metrics_improved=False)
+
+    monkeypatch.setattr(RemediationVerifier, "verify", failing_verify)
+    clean = _drive_lifecycle("crashloop_deploy", seed=9)
+    faulted = _drive_lifecycle(
+        "crashloop_deploy", seed=9,
+        faults=[Fault(stage="compensate", at=0, kind="crash"),
+                Fault(stage="wf_execute", at=1, kind="crash")])
+    assert faulted.resumes >= 1
+    _assert_parity(faulted, clean)
+    assert faulted.results["compensate_remediation"]["compensated"] is True
+    assert faulted.status == "closed"
+
+
+# ---------------------------------------------------------------------------
+# bench record smoke
+# ---------------------------------------------------------------------------
+
+def test_bench_incident_lifecycle_record_smoke():
+    import bench
+    rec = bench.bench_incident_lifecycle(
+        num_pods=60, incidents=3, crash_rate=0.5, seed=3, verbose=False)
+    assert rec["metric"] == "incident_lifecycle"
+    assert rec["duplicate_mutations"] == 0
+    assert rec["state_parity"] is True
+    assert rec["resumes"] >= 1
+    assert rec["mttr_unfaulted_ms"] > 0 and rec["mttr_faulted_ms"] > 0
+    assert rec["incidents"] == 3 and rec["value"] > 0
