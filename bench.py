@@ -2361,6 +2361,191 @@ def _pallas_ab_record(be, snapshot, batch, modeled_floor_s) -> None:
                           "error": str(exc)}), flush=True)
 
 
+def _fused_tick_ab_record() -> None:
+    """graft-fuse A/B: the fused streaming tick vs the composed
+    scatter→kernel→score tick.
+
+    Modeled numbers come from the graft-cost walker at the CANONICAL
+    registry tick shapes (abstract trace — free at any scale): HBM
+    bytes/tick for the fused kernel vs BOTH compositions (Pallas and
+    XLA), the modeled floor each implies, and the dot-FLOP identity that
+    proves all three run the same math. Parity runs CONCRETELY at small
+    hermetic shapes (interpret mode): fused logits bit-equal to the
+    composed tick, fused grads vs jax.grad of the XLA composed tick at
+    f32 tolerance. Wall time is honest-nulled off-TPU (interpret mode
+    would measure the interpreter, same policy as the pallas A/B)."""
+    import jax
+
+    try:
+        import numpy as _np
+        from functools import partial as _partial
+
+        from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+            cost_jaxpr)
+        from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+            _params, _rel_offsets)
+        from kubernetes_aiops_evidence_graph_tpu.graph.schema import DIM
+        from kubernetes_aiops_evidence_graph_tpu.ops.pallas_segment import (
+            pallas_fused_gnn_tick)
+        from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+        from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+            _gnn_fused_tick, _gnn_tick)
+
+        interpret = jax.devices()[0].platform != "tpu"
+        anchors = device_anchors()
+        offs = _rel_offsets()
+        pn, pi, pk, ek = 4096, 32, 64, 64
+        pe = int(offs[-1])
+        params = _params()
+        ints = _np.zeros(3 * pk + 5 * ek + 2 * pi, _np.int32)
+        args = (params, _np.zeros((pn, DIM), _np.float32),
+                _np.zeros(pn, _np.int32), _np.ones(pn, _np.float32),
+                _np.zeros(pe, _np.int32), _np.zeros(pe, _np.int32),
+                _np.full(pe, -1, _np.int32), _np.zeros(pe, _np.float32),
+                ints)
+        costs = {}
+        for name, fn in (
+                ("fused", _partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi,
+                                   rel_offsets=offs)),
+                ("composed_pallas", _partial(
+                    _gnn_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                    slices_sorted=False, compute_dtype=None, pallas=True)),
+                ("composed_xla", _partial(
+                    _gnn_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                    slices_sorted=False, compute_dtype=None,
+                    pallas=False))):
+            costs[name] = cost_jaxpr(name, jax.make_jaxpr(fn)(*args))
+
+        def floor_ms(c):
+            return 1e3 * max(c.hbm_bytes / (anchors["hbm_gbps"] * 1e9),
+                             c.flops / (anchors["bf16_tflops"] * 1e12))
+
+        # concrete parity at small hermetic shapes (fast in interpret)
+        rng = _np.random.default_rng(0)
+        s_caps, s_live = (64, 128), (40, 90)
+        s_offs = (0,) + tuple(int(c) for c in _np.cumsum(s_caps))
+        s_pe, s_pn, s_pi = s_offs[-1], 256, 8
+        s_params = gnn.init_params(jax.random.PRNGKey(0), hidden=16,
+                                   layers=2)
+        feats = rng.standard_normal((s_pn, DIM)).astype(_np.float32)
+        kind = rng.integers(0, 5, s_pn).astype(_np.int32)
+        nmask = _np.ones(s_pn, _np.float32)
+        esrc = rng.integers(0, s_pn, s_pe).astype(_np.int32)
+        edst = _np.full(s_pe, s_pn - 1, _np.int32)
+        erel = _np.full(s_pe, -1, _np.int32)
+        emask = _np.zeros(s_pe, _np.float32)
+        for r, c in enumerate(s_live):
+            lo = s_offs[r]
+            edst[lo:lo + c] = _np.sort(rng.integers(0, s_pn, c))
+            erel[lo:lo + c] = r
+            emask[lo:lo + c] = 1.0
+        s_ints = _np.zeros(3 * pk + 5 * ek + 2 * s_pi, _np.int32)
+        s_ints[:pk] = s_pn
+        s_ints[3 * pk:3 * pk + ek] = s_pe
+        io = 3 * pk + 5 * ek
+        s_ints[io:io + s_pi] = rng.integers(0, s_pn, s_pi)
+        s_ints[io + s_pi:io + 2 * s_pi] = 1
+
+        def mirrors():
+            import jax.numpy as jnp
+            return (jnp.asarray(kind), jnp.asarray(nmask),
+                    jnp.asarray(esrc), jnp.asarray(edst),
+                    jnp.asarray(erel), jnp.asarray(emask))
+
+        import jax.numpy as jnp
+        comp = _gnn_tick(s_params, jnp.asarray(feats), *mirrors(),
+                         jnp.asarray(s_ints), pk=pk, ek=ek, pi=s_pi,
+                         rel_offsets=s_offs, slices_sorted=False,
+                         compute_dtype=None, pallas=True)
+        fused = pallas_fused_gnn_tick(
+            s_params, jnp.asarray(feats), *mirrors(),
+            jnp.asarray(s_ints), pk=pk, ek=ek, pi=s_pi,
+            rel_offsets=s_offs)
+        logits_bit_identical = bool(_np.array_equal(
+            _np.asarray(comp[6]), _np.asarray(fused[6])))
+        ct = jnp.asarray(rng.standard_normal(
+            (s_pi, gnn.NUM_CLASSES)).astype(_np.float32))
+        gx = jax.grad(lambda p: (_gnn_tick(
+            p, jnp.asarray(feats), *mirrors(), jnp.asarray(s_ints),
+            pk=pk, ek=ek, pi=s_pi, rel_offsets=s_offs,
+            slices_sorted=False, compute_dtype=None,
+            pallas=False)[6] * ct).sum())(s_params)
+        gf = jax.grad(lambda p: (pallas_fused_gnn_tick(
+            p, jnp.asarray(feats), *mirrors(), jnp.asarray(s_ints),
+            pk=pk, ek=ek, pi=s_pi, rel_offsets=s_offs)[6] * ct).sum())(
+                s_params)
+        grad_parity = max(
+            float(_np.abs(_np.asarray(a) - _np.asarray(b)).max())
+            for a, b in zip(jax.tree_util.tree_leaves(gx),
+                            jax.tree_util.tree_leaves(gf)))
+
+        fu, cp, cx = (costs["fused"], costs["composed_pallas"],
+                      costs["composed_xla"])
+        rec = {
+            "metric": "gnn_fused_tick_vs_composed",
+            "unit": "modeled_hbm_bytes_per_tick",
+            "value": fu.hbm_bytes,
+            "vs_baseline": round(cp.hbm_bytes / max(fu.hbm_bytes, 1), 2),
+            "interpret": interpret,
+            "fused_hbm_bytes": fu.hbm_bytes,
+            "composed_pallas_hbm_bytes": cp.hbm_bytes,
+            "composed_xla_hbm_bytes": cx.hbm_bytes,
+            "bytes_vs_composed_pallas": round(
+                cp.hbm_bytes / max(fu.hbm_bytes, 1), 2),
+            "bytes_vs_composed_xla": round(
+                cx.hbm_bytes / max(fu.hbm_bytes, 1), 2),
+            "dot_mflop": {"fused": round(fu.dot_flops / 1e6, 1),
+                          "composed_pallas": round(cp.dot_flops / 1e6, 1),
+                          "composed_xla": round(cx.dot_flops / 1e6, 1)},
+            "modeled_floor_ms": {
+                "fused": round(floor_ms(fu), 4),
+                "composed_pallas": round(floor_ms(cp), 4),
+                "composed_xla": round(floor_ms(cx), 4)},
+            "logits_bit_identical": logits_bit_identical,
+            "grad_parity_max_abs": grad_parity,
+            "anchors": dict(anchors),
+        }
+        if interpret:
+            rec.update(
+                fused_ms=None, composed_ms=None, roofline_pct=None,
+                note="fused tick not timed off-TPU (interpret mode would "
+                     "measure the interpreter); modeled bytes + concrete "
+                     "parity carry the record, tier-1 pins the rest")
+        else:
+            import time as _time
+
+            def wall(fn, fresh_args):
+                fn(*fresh_args())    # compile
+                t0 = _time.perf_counter()
+                out = fn(*fresh_args())
+                jax.block_until_ready(out[-1])
+                return _time.perf_counter() - t0
+
+            def fresh_canonical():
+                import jax.numpy as jnp
+                return (params, jnp.asarray(args[1]),
+                        jnp.asarray(args[2]), jnp.asarray(args[3]),
+                        jnp.asarray(args[4]), jnp.asarray(args[5]),
+                        jnp.asarray(args[6]), jnp.asarray(args[7]),
+                        jnp.asarray(ints))
+
+            fused_s = wall(_partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi,
+                                    rel_offsets=offs), fresh_canonical)
+            comp_s = wall(_partial(_gnn_tick, pk=pk, ek=ek, pi=pi,
+                                   rel_offsets=offs, slices_sorted=False,
+                                   compute_dtype=None, pallas=True),
+                          fresh_canonical)
+            rec.update(fused_ms=round(fused_s * 1e3, 3),
+                       composed_ms=round(comp_s * 1e3, 3),
+                       roofline_pct=round(
+                           100.0 * (floor_ms(fu) / 1e3) / fused_s, 2))
+        print(json.dumps(rec), flush=True)
+    except (Exception, SystemExit) as exc:
+        print(json.dumps({"metric": "gnn_fused_tick_vs_composed",
+                          "value": 0, "unit": "error", "vs_baseline": 0,
+                          "error": str(exc)}), flush=True)
+
+
 def _gnn_and_trace_records(snapshot) -> None:
     """Config-3 companions, printed as their own JSON records BEFORE the
     headline line (the driver pins the LAST line): the GNN forward's
@@ -2450,6 +2635,7 @@ def _gnn_and_trace_records(snapshot) -> None:
             **roof,
         }), flush=True)
         _pallas_ab_record(be, snapshot, b, modeled_floor_s)
+        _fused_tick_ab_record()
     except (Exception, SystemExit) as exc:
         print(json.dumps({"metric": "gnn_forward_50knodes_500incidents",
                           "value": 0, "unit": "error", "vs_baseline": 0,

@@ -107,6 +107,12 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
         ("pk", "ek", "pi", "rel_offsets", "slices_sorted", "compute_dtype",
          "pallas"),
         (2, 3, 4, 5, 6, 7)),
+    # graft-fuse: the fused streaming tick — same donation contract as
+    # _gnn_tick (the resident mirror flows through the one Pallas
+    # kernel's aliased outputs, never reallocates)
+    ("rca/gnn_streaming.py", "_gnn_fused_tick"): (
+        ("pk", "ek", "pi", "rel_offsets"),
+        (2, 3, 4, 5, 6, 7)),
     # graft-shield snapshot kernels: pack/unpack the resident state into
     # ONE int32 transfer (no donation — the resident buffers must survive
     # the snapshot; registered jaxpr entrypoints with zero-collective cost)
@@ -119,7 +125,7 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
     # slab is a host staging buffer, the outputs feed the tick's
     # NON-donated ints/rows operands; registered jaxpr entrypoint
     # ingest.delta_pack with zero-collective cost)
-    ("rca/streaming.py", "_delta_pack"): (("li", "pk", "dim"), ()),
+    ("rca/streaming.py", "_delta_pack"): (("li", "pk", "dim", "gi"), ()),
     # graft-fleet mesh-resident ticks (parallel/sharded_streaming.py):
     # same donation contract as their single-device counterparts — the
     # sharded resident mirror flows through, never reallocates

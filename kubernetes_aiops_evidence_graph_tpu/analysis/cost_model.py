@@ -13,11 +13,17 @@ entrypoint's closed jaxpr and roll up
 * **HBM read/write bytes** from operand/result avals of every leaf
   equation — a traffic *model*, not a fusion-aware simulation: it is
   deterministic, monotone in what the program materializes, and that is
-  exactly what a ratchet needs. Ref-typed avals (Pallas kernel refs —
-  resident VMEM buffers) are excluded: a ``get``/``swap`` equation moves
-  its VALUE operands/results, not the whole buffer it indexes into, so
-  only non-ref avals count (otherwise a tiled kernel's per-edge row get
-  would model the full ``[N, H]`` table per iteration);
+  exactly what a ratchet needs. Pallas kernels are charged at the CALL
+  SITE (graft-fuse): a VMEM-resident kernel's true HBM traffic is what
+  streams in and out of the ``pallas_call`` — its operand and result
+  avals, once per call — while every value flow INSIDE the kernel body
+  (ref ``get``/``swap``, tile scratch math) is VMEM traffic and adds
+  nothing to HBM bytes. (The previous model charged in-kernel value
+  flows as HBM, which both overcharged per-row VMEM accesses ~3× and
+  gave fusion zero credit for the inter-kernel HBM round-trips it
+  eliminates — the fused tick's whole reason to exist.) In-kernel
+  materialization stays policed by the per-intermediate byte budget and
+  the peak-liveness number below, which DO keep counting kernel values;
 * **peak live-intermediate bytes** via per-scope liveness (def →
   last-use) with container equations contributing their inner scope's
   peak while live. Ref avals are excluded here too: a kernel ref is a
@@ -217,35 +223,49 @@ def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
     jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
     cost = EntryCost(name=name)
 
-    def walk(jx, mult: int) -> None:
+    def walk(jx, mult: int, in_kernel: bool = False) -> None:
         for eqn in jx.eqns:
             prim = eqn.primitive.name
             inner_mult = mult
+            inner_kernel = in_kernel
             if prim == "scan":
                 inner_mult = mult * int(eqn.params.get("length", 1))
             elif prim == "pallas_call":
-                # the kernel jaxpr is one grid step: weight by grid size
+                # the kernel jaxpr is one grid step: weight COMPUTE by
+                # grid size; HBM traffic is charged HERE, at the call
+                # site — the kernel's operand/result streams are what
+                # actually crosses HBM↔VMEM (once per call: constant-
+                # index blocks load once, tiled blocks tile the same
+                # total bytes), and everything inside the body is VMEM
                 grid = getattr(eqn.params.get("grid_mapping"), "grid",
                                ()) or ()
                 steps = 1
                 for d in grid:
                     steps *= int(d)
                 inner_mult = mult * max(steps, 1)
+                inner_kernel = True
+                call_reads = sum(_aval_bytes(v.aval) for v in eqn.invars
+                                 if _is_var(v) and not _is_ref(v.aval))
+                call_writes = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                                  if not _is_ref(v.aval))
+                cost.hbm_read_bytes += call_reads * mult
+                cost.hbm_write_bytes += call_writes * mult
             subs = list(_eqn_sub_jaxprs(eqn))
             if subs:
                 for sub in subs:
-                    walk(sub, inner_mult)
+                    walk(sub, inner_mult, inner_kernel)
                 continue
             cost.eqn_counts[prim] = cost.eqn_counts.get(prim, 0) + mult
             flops, dot = _eqn_flops(eqn)
             cost.flops += flops * mult
             cost.dot_flops += dot * mult
-            reads = sum(_aval_bytes(v.aval) for v in eqn.invars
-                        if _is_var(v) and not _is_ref(v.aval))
-            writes = sum(_aval_bytes(v.aval) for v in eqn.outvars
-                         if not _is_ref(v.aval))
-            cost.hbm_read_bytes += reads * mult
-            cost.hbm_write_bytes += writes * mult
+            if not in_kernel:
+                reads = sum(_aval_bytes(v.aval) for v in eqn.invars
+                            if _is_var(v) and not _is_ref(v.aval))
+                writes = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                             if not _is_ref(v.aval))
+                cost.hbm_read_bytes += reads * mult
+                cost.hbm_write_bytes += writes * mult
             if prim in COLLECTIVE_PRIMS:
                 # payload: what moves over the interconnect — the gathered
                 # result for all_gather, the shipped operand otherwise

@@ -54,6 +54,18 @@ PALLAS_N = 4096
 PALLAS_REL_COUNTS = tuple(4 * c for c in REL_COUNTS)
 PALLAS_TILE_BUDGET = 2 * MIB
 
+# graft-fuse budgets. The fused streaming tick keeps every [N, H]
+# activation VMEM-resident (pn=4096, H=64 → 1 MiB per live table): its
+# largest legitimate in-kernel intermediate is one whole-table value
+# (the embed/layer-update products), so 4 MiB comfortably admits the
+# resident math and rejects anything [E, H]- or [N, R, H]-scaled. The
+# gms vjp trace carries forward + both backward kernels at the Pallas
+# canonical shapes — its peak is the co-live (h, cotangent, dh)
+# tables + the [R, H, K] grad accumulator, still well under the
+# slice-materialization scale the budget exists to reject.
+FUSED_TICK_BUDGET = 4 * MIB
+PALLAS_VJP_BUDGET = 6 * MIB
+
 # bucketed forward paths may not contain a set-scatter at all — the only
 # scatters are the per-slice 1-D dst segment-adds
 NO_SET_SCATTER = CALLBACK_PRIMS | frozenset({"scatter"})
@@ -335,6 +347,64 @@ def _gnn_tick_coalesced_build():
     queue-full merges land here first)."""
     from ..rca.streaming import _DELTA_BUCKETS
     return _gnn_tick_build(pk=_DELTA_BUCKETS[-1], ek=_DELTA_BUCKETS[-1])
+
+
+def _gnn_fused_tick_build():
+    """graft-fuse: the fused streaming tick — ONE pallas_call from the
+    packed delta scatter through the relation-bucketed message pass to
+    the logits/probs reduction, at the canonical GNN-tick shapes. The
+    [N, H] activations live in VMEM scratch for the whole tick, so the
+    modeled HBM bytes/tick must land STRICTLY below the composed
+    streaming.gnn_tick.bucketed path's — the ratchet pins the lower
+    floor once recorded."""
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.gnn_streaming import _gnn_fused_tick
+    offs = _rel_offsets()
+    pn, pi = 4096, 32
+    pe = int(offs[-1])
+    pk = ek = 64
+    ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
+    fn = partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs)
+    args = (_params(), np.zeros((pn, DIM), np.float32),
+            np.zeros(pn, np.int32), np.ones(pn, np.float32),
+            np.zeros(pe, np.int32), np.zeros(pe, np.int32),
+            np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
+    return fn, args
+
+
+def _pallas_gms_vjp_build():
+    """graft-fuse: gradients THROUGH the Pallas gather_matmul_segment —
+    the custom_vjp's forward kernel plus both backward kernels (the
+    transposed-layout dh pass and the per-relation [H, K] grad-matmul
+    accumulator) traced as one value_and_grad at the Pallas canonical
+    shapes. Pins that the backward stays tile-shaped: no [E_r, H]
+    slice materialization, no collectives, f32 accumulation."""
+    import jax
+    np = _np()
+    from ..graph.snapshot import rel_slice_offsets
+    from ..ops.pallas_segment import pallas_gather_matmul_segment
+    offs = rel_slice_offsets(PALLAS_REL_COUNTS)
+    n, h = PALLAS_N, HIDDEN
+    pe = int(offs[-1])
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, n, pe).astype(np.int32)
+    dst = np.full(pe, n - 1, np.int32)
+    mask = np.zeros(pe, np.float32)
+    for r, (lo, hi) in enumerate(zip(offs[:-1], offs[1:])):
+        c = PALLAS_REL_COUNTS[r]
+        dst[lo:lo + c] = np.sort(rng.integers(0, n, c)).astype(np.int32)
+        mask[lo:lo + c] = 1.0
+    srcj, dstj, maskj = src, dst, mask
+
+    def loss(hh, ww):
+        return pallas_gather_matmul_segment(
+            hh, ww, srcj, dstj, maskj, offs, n, slices_sorted=True,
+            interpret=True).sum()
+
+    fn = jax.grad(loss, argnums=(0, 1))
+    return fn, (np.zeros((n, h), np.float32),
+                np.zeros((len(PALLAS_REL_COUNTS), h, h), np.float32))
 
 
 def _sharded_rules_tick_build():
@@ -649,6 +719,25 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "grow compute implicitly)",
         cost=COST_DEFAULT),
     Entrypoint("streaming.gnn_tick.bucketed", _gnn_tick_build, _TICK),
+    Entrypoint(
+        "streaming.gnn_tick.fused", _gnn_fused_tick_build,
+        InvariantSpec(max_intermediate_bytes=FUSED_TICK_BUDGET),
+        notes="graft-fuse: delta scatter → message pass → verdict in ONE "
+              "pallas_call; [N, H] activations stay VMEM-resident across "
+              "stages (the 4 MiB budget admits whole-table values and "
+              "rejects [E, H]/[N, R, H] materializations); modeled HBM "
+              "bytes/tick ratcheted STRICTLY below the composed tick's; "
+              "explicit zero-collective CostSpec",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "ops.pallas_gms.vjp", _pallas_gms_vjp_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=PALLAS_VJP_BUDGET),
+        notes="graft-fuse: grads through the Pallas gms custom_vjp — "
+              "forward + transposed-layout dh kernel + per-relation "
+              "[H, K] grad-matmul kernel; backward must stay tile-shaped "
+              "(no [E_r, H] slice materialization) and zero-collective",
+        cost=COST_DEFAULT),
     Entrypoint(
         "streaming.rules_tick.coalesced", _rules_tick_coalesced_build,
         _TICK,

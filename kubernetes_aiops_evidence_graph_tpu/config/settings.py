@@ -144,6 +144,17 @@ class Settings:
     # streaming tick keep the XLA kernel (the parity oracle). Off-TPU the
     # kernel runs in interpret mode (tier-1 CPU tests exercise it so).
     gnn_pallas: bool = False
+    # graft-fuse: the fused streaming tick (ops/pallas_segment.py::
+    # pallas_fused_gnn_tick) — delta scatter, message pass and score
+    # reduction in ONE Pallas kernel, so the [N, H] activations never
+    # round-trip through HBM between stages. Bit-identical to the
+    # composed scatter→kernel→score tick (the parity oracle, which stays
+    # the default); f32 bucketed layouts only — every other
+    # configuration silently keeps the composed tick. On the sharded
+    # mirror this promotes the SHARD-LOCAL kernel to Pallas while halo
+    # assembly stays in XLA. The shield's kernel-fallback rung degrades
+    # fused → composed → XLA under repeated device faults.
+    gnn_fused_tick: bool = False
     llm_provider: str = "none"                     # none|gemini|openai|ollama
     llm_api_key: str = ""
     llm_model: str = ""
@@ -313,6 +324,13 @@ class Settings:
     # (parallel/sharded_gnn.make_sharded_train_step) on a (1 x D) data
     # mesh — forced host devices on CPU, same fallback as serving
     learn_mesh_shards: int = 1
+    # graft-fuse: run the online fine-tune through the Pallas vjp tier
+    # (ops/pallas_segment.py custom_vjp — forward AND backward as Pallas
+    # kernels). Guarded by a gate-time parity check: the first cycle
+    # compares one step's loss+grads against the XLA step and silently
+    # falls back to XLA on mismatch, so a lowering bug can never reach a
+    # hot swap (learn/trainer.py::finetune).
+    learn_pallas_grads: bool = False
     mesh_dp: int = 1                               # data-parallel axis (incidents)
     mesh_graph: int = 1                            # graph-parallel axis (node shards)
     node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)

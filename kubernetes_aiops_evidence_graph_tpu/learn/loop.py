@@ -187,7 +187,9 @@ class OnlineLearner:
             steps=int(self.settings.learn_steps),
             lr=float(self.settings.learn_lr),
             anchor_weight=float(self.settings.learn_anchor_weight),
-            mesh_shards=int(self.settings.learn_mesh_shards))
+            mesh_shards=int(self.settings.learn_mesh_shards),
+            pallas_grads=bool(getattr(self.settings,
+                                      "learn_pallas_grads", False)))
 
     def gate(self, candidate) -> tuple[bool, dict]:
         """(passes, evals). The candidate must be finite AND match-or-beat

@@ -42,11 +42,17 @@ from ..rca import gnn
 log = get_logger("learn.trainer")
 
 
-def make_finetune_step(tx):
+def make_finetune_step(tx, pallas: bool = False):
     """jitted ``(params, opt_state, anchor, anchor_weight, batch) ->
     (params, opt_state, loss)`` — the online fine-tune step (see module
     docstring). ``anchor_weight`` is a traced scalar (a per-cycle knob
-    must not mint a compile); the anchor tree is read-only."""
+    must not mint a compile); the anchor tree is read-only.
+
+    ``pallas=True`` (settings.learn_pallas_grads, graft-fuse) runs the
+    loss through the Pallas kernel's custom_vjp — forward AND backward
+    as Pallas kernels — instead of the XLA oracle. ``finetune`` gates
+    the tier behind a one-step loss+grad parity check against the XLA
+    step before any candidate can reach a hot swap."""
 
     # params/opt_state are consumed and rebound every step (the offline
     # step's donation discipline, rca/gnn.py); the anchor is NOT donated —
@@ -62,7 +68,8 @@ def make_finetune_step(tx):
                 batch["edge_src"], batch["edge_dst"], batch["edge_rel"],
                 batch["edge_mask"], batch["incident_nodes"],
                 batch["labels"], batch["label_mask"],
-                rel_offsets=rel_offsets, slices_sorted=slices_sorted)
+                rel_offsets=rel_offsets, slices_sorted=slices_sorted,
+                pallas=pallas and rel_offsets is not None)
             prox = jax.tree_util.tree_reduce(
                 lambda a, b: a + b,
                 jax.tree_util.tree_map(
@@ -99,14 +106,47 @@ def _interleave(prod: list, sim: list, steps: int) -> list:
     return out
 
 
+def _pallas_grads_parity_ok(params, episode, rtol: float = 1e-4,
+                            atol: float = 1e-4) -> bool:
+    """Gate-time parity check for the Pallas vjp tier (graft-fuse): one
+    loss + grad evaluation through the Pallas custom_vjp vs the XLA
+    reference on a real episode, leaf-wise allclose. A lowering bug must
+    die HERE — before a single candidate step, let alone a hot swap."""
+    batch, offs = _clean_batch(episode)
+    if offs is None:
+        return False      # the Pallas tier needs the bucketed layout
+
+    def loss(p, pal):
+        return gnn.loss_fn(
+            p, batch["features"], batch["node_kind"], batch["node_mask"],
+            batch["edge_src"], batch["edge_dst"], batch["edge_rel"],
+            batch["edge_mask"], batch["incident_nodes"],
+            batch["labels"], batch["label_mask"],
+            rel_offsets=offs, slices_sorted=False, pallas=pal)
+
+    lx, gx = jax.value_and_grad(loss)(params, False)
+    lp, gp = jax.value_and_grad(loss)(params, True)
+    if not np.allclose(float(lx), float(lp), rtol=rtol, atol=atol):
+        return False
+    for a, b in zip(jax.tree_util.tree_leaves(gx),
+                    jax.tree_util.tree_leaves(gp)):
+        if not np.allclose(np.asarray(a), np.asarray(b),
+                           rtol=rtol, atol=atol):
+            return False
+    return True
+
+
 def finetune(serving_params, episodes: list, sim_episodes: list,
              steps: int, lr: float, anchor_weight: float,
-             mesh_shards: int = 1) -> dict:
+             mesh_shards: int = 1, pallas_grads: bool = False) -> dict:
     """Fine-tune a candidate from ``serving_params`` over the interleaved
     production/simulator schedule. Returns ``{"params", "steps",
-    "final_loss", "sharded"}`` — the candidate is a FRESH tree (the
-    serving tree is never mutated; the swap is the only way a candidate
-    reaches serving)."""
+    "final_loss", "sharded", "pallas"}`` — the candidate is a FRESH tree
+    (the serving tree is never mutated; the swap is the only way a
+    candidate reaches serving). ``pallas_grads=True``
+    (settings.learn_pallas_grads) promotes the single-device tier to the
+    Pallas vjp kernels AFTER the gate-time parity check passes on the
+    first episode; any mismatch falls back to the XLA step, logged."""
     import optax
     if not episodes and not sim_episodes:
         raise ValueError("finetune needs at least one episode")
@@ -118,7 +158,12 @@ def finetune(serving_params, episodes: list, sim_episodes: list,
             return _finetune_sharded(serving_params, schedule, tx, mesh)
         log.warning("learn_mesh_unavailable", shards=mesh_shards)
 
-    step = make_finetune_step(tx)
+    use_pallas = False
+    if pallas_grads:
+        use_pallas = _pallas_grads_parity_ok(serving_params, schedule[0])
+        if not use_pallas:
+            log.warning("learn_pallas_parity_failed_falling_back_to_xla")
+    step = make_finetune_step(tx, pallas=use_pallas)
     anchor = jax.tree_util.tree_map(jnp.asarray, serving_params)
     params = jax.tree_util.tree_map(jnp.array, anchor)   # fresh candidate
     opt_state = tx.init(params)
@@ -131,7 +176,8 @@ def finetune(serving_params, episodes: list, sim_episodes: list,
             rel_offsets=offs, slices_sorted=offs is not None)
         obs_metrics.LEARN_TRAIN_STEPS.inc()
     return {"params": params, "steps": len(schedule),
-            "final_loss": float(jax.device_get(loss)), "sharded": False}
+            "final_loss": float(jax.device_get(loss)), "sharded": False,
+            "pallas": use_pallas}
 
 
 def _data_mesh(shards: int):

@@ -41,14 +41,44 @@ the signature for dispatch symmetry with the XLA kernel.
 
 House style follows ``experiments/pallas_rules.py``: static tables built
 host-side, ``interpret=True`` on CPU (auto-detected when not forced) so
-tier-1 stays hermetic, bit-parity tests against the XLA kernel. Forward/
-serving only — there is no custom_vjp here; training and gradients stay
-on the XLA bucketed kernel (``settings.gnn_pallas`` gates dispatch in
-``rca/gnn.py``).
+tier-1 stays hermetic, bit-parity tests against the XLA kernel.
+
+graft-fuse extends this module in two directions:
+
+* **A real backward pass.** ``pallas_gather_matmul_segment`` now carries a
+  ``custom_vjp`` whose backward IS the transposed segment layout: the
+  cotangent table is gathered at ``dst`` and dst-bucket-scattered at
+  ``src`` through the SAME tiled forward kernel with ``w_rel``
+  transposed (``dh``), while ``dw_rel`` accumulates per-relation
+  ``[H, K]`` grad matmuls (one ``[EDGE_TILE, H]ᵀ × [EDGE_TILE, K]`` MXU
+  matmul per tile, f32 accumulation into a VMEM-resident ``[R, H, K]``
+  accumulator seeded via input/output aliasing). Gradients flow to ``h``
+  and ``w_rel`` only — ``mask`` (and the int index arrays) are treated
+  as constants of the layout, which is exact for the 0/1 masks every
+  caller passes; a caller differentiating w.r.t. a fractional mask must
+  use the XLA kernel. Training and the online fine-tune
+  (``settings.learn_pallas_grads``) can therefore leave the XLA oracle;
+  the A/B parity suite pins the grads against ``jax.grad`` of the XLA
+  reference (tests/test_ops.py).
+
+* **The fused streaming tick** (``pallas_fused_gnn_tick``, behind
+  ``settings.gnn_fused_tick``): ONE ``pallas_call`` from delta-scatter
+  to verdict — the staged int32 delta slab scatters into the
+  VMEM-resident node/edge mirrors (aliased inputs→outputs, exactly the
+  donated resident state), the relation-bucketed message pass runs as
+  EDGE_TILE sweeps against the resident tables, and the score reduction
+  (incident readout → logits → softmax) happens in-kernel — so the
+  ``[N, H]`` activations never round-trip through HBM between the
+  scatter, message-pass and scoring stages the composed
+  ``_gnn_tick`` pays per tick. Bit-identical to the composed
+  scatter→``pallas_gather_matmul_segment``→score path (per-tile matmuls
+  and per-edge accumulation replay the identical fold). Its
+  ``custom_vjp`` rematerializes the composed forward over the
+  differentiable Pallas gms above, so the fused tier is trainable too.
 """
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import numpy as np
 
@@ -135,7 +165,12 @@ def pallas_gather_matmul_segment(
     change the math here (the VMEM accumulate is order-exact either way);
     it is accepted so dispatch sites key both kernels identically.
     ``interpret=None`` auto-selects interpret mode off-TPU so tier-1 CPU
-    tests exercise the kernel hermetically."""
+    tests exercise the kernel hermetically.
+
+    Differentiable w.r.t. ``h`` and ``w_rel`` (graft-fuse): the attached
+    ``custom_vjp`` runs the transposed-layout Pallas backward (module
+    docstring). ``mask`` is treated as a layout constant (zero
+    cotangent) — exact for 0/1 masks."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     out_dtype = h.dtype
@@ -151,6 +186,18 @@ def pallas_gather_matmul_segment(
         return gather_matmul_segment(
             h, w_rel, src, dst, mask, offs, num_segments,
             slices_sorted=slices_sorted, compute_dtype=compute_dtype)
+    cdt = None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    return _gms_vjp(offs, int(num_segments), bool(interpret), cdt,
+                    h, w_rel, src, dst, mask)
+
+
+def _gms_forward(offs, num_segments, interpret, compute_dtype,
+                 h, w_rel, src, dst, mask) -> jax.Array:
+    """The tiled kernel invocation (EDGE_TILE-aligned layouts only —
+    callers have already routed empty/unaligned layouts elsewhere)."""
+    out_dtype = h.dtype
+    k = w_rel.shape[-1]
+    e_total = offs[-1]
     if compute_dtype is not None:
         # cast ONCE before the kernel, exactly like the XLA kernel: the
         # gathered rows then move at compute-dtype width and the matmul
@@ -204,3 +251,369 @@ def pallas_gather_matmul_segment(
       jnp.reshape(src, (num_tiles, EDGE_TILE)),
       jnp.reshape(dst, (num_tiles, EDGE_TILE)),
       jnp.reshape(mask, (num_tiles, EDGE_TILE)))
+
+
+# -- custom_vjp: the transposed segment layout (graft-fuse) ----------------
+
+def _grad_w_kernel(rel_ref, dw_init_ref, h_ref, g_ref, src_ref, dst_ref,
+                   mask_ref, dw_ref, gath_ref, ct_ref):
+    """One edge tile of the ``w_rel`` backward: gather the (masked)
+    source rows and the cotangent rows, one ``[H, EDGE_TILE] ×
+    [EDGE_TILE, K]`` MXU matmul, accumulate into the VMEM-resident
+    ``[R, H, K]`` grad table (seeded via input/output aliasing —
+    ``dw_init_ref`` is never read here). f32 accumulation regardless of
+    the compute dtype, the same discipline as the forward tile matmul."""
+    t = pl.program_id(0)
+
+    def gather_row(e, _):
+        gath_ref[e, :] = h_ref[src_ref[0, e], :] * mask_ref[0, e]
+        ct_ref[e, :] = g_ref[dst_ref[0, e], :]
+        return 0
+
+    jax.lax.fori_loop(0, EDGE_TILE, gather_row, 0)
+
+    rel = rel_ref[t]
+    dw_ref[rel] = dw_ref[rel] + jnp.dot(
+        gath_ref[:].T, ct_ref[:], preferred_element_type=dw_ref.dtype)
+
+
+def _gms_grad_w(offs, interpret, compute_dtype, h, g, src, dst, mask,
+                w_dtype, num_rels: int) -> jax.Array:
+    """[R, H, K] per-relation weight grads over the bucketed layout:
+    ``dw_r = Σ_{e ∈ slice r} (h[src_e]·mask_e)ᵀ ⊗ g[dst_e]``.
+    ``num_rels`` is the FULL relation-table depth (``w_rel.shape[0]``) —
+    it may exceed the layout's slice count, in which case the surplus
+    relations correctly get zero grads."""
+    e_total = offs[-1]
+    if compute_dtype is not None:
+        # the forward computed messages from compute-dtype operands; the
+        # gathered rows re-materialize at the same width (cotangents stay
+        # f32 — grads accumulate at full precision)
+        h = h.astype(compute_dtype)
+        mask = mask.astype(compute_dtype)
+    num_tiles = e_total // EDGE_TILE
+    rel_ids = jnp.asarray(_tile_rel_ids(offs))
+    hidden, k = h.shape[1], g.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((num_rels, hidden, k), lambda t, rel_ref: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(h.shape, lambda t, rel_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(g.shape, lambda t, rel_ref: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, EDGE_TILE), lambda t, rel_ref: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((num_rels, hidden, k),
+                               lambda t, rel_ref: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((EDGE_TILE, hidden), h.dtype),   # gathered rows
+            pltpu.VMEM((EDGE_TILE, k), g.dtype),        # cotangent rows
+        ],
+    )
+    return pl.pallas_call(
+        _grad_w_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_rels, hidden, k), w_dtype),
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(rel_ids, jnp.zeros((num_rels, hidden, k), w_dtype), h, g,
+      jnp.reshape(src, (num_tiles, EDGE_TILE)),
+      jnp.reshape(dst, (num_tiles, EDGE_TILE)),
+      jnp.reshape(mask, (num_tiles, EDGE_TILE)))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _gms_vjp(offs, num_segments, interpret, compute_dtype,
+             h, w_rel, src, dst, mask):
+    return _gms_forward(offs, num_segments, interpret, compute_dtype,
+                        h, w_rel, src, dst, mask)
+
+
+def _gms_vjp_fwd(offs, num_segments, interpret, compute_dtype,
+                 h, w_rel, src, dst, mask):
+    out = _gms_forward(offs, num_segments, interpret, compute_dtype,
+                       h, w_rel, src, dst, mask)
+    return out, (h, w_rel, src, dst, mask)
+
+
+def _gms_vjp_bwd(offs, num_segments, interpret, compute_dtype, res, g):
+    """The backward IS the transposed segment layout: ``dh`` re-runs the
+    forward kernel with the cotangent table gathered at ``dst``,
+    scattered at ``src`` and ``w_rel`` transposed (a dst-bucketed
+    scatter of cotangents over the same static slices); ``dw_rel`` is
+    the per-relation grad-matmul kernel above. Index arrays and ``mask``
+    get zero cotangents (mask is a 0/1 layout constant — scalar
+    multiplication commutes exactly through the matmul for 0/1, so the
+    h/w grads match the XLA kernel's within f32 reassociation
+    tolerance)."""
+    h, w_rel, src, dst, mask = res
+    w_t = jnp.swapaxes(w_rel, -1, -2)            # [R, K, H]
+    dh = _gms_forward(offs, h.shape[0], interpret, compute_dtype,
+                      g, w_t, dst, src, mask)
+    dw = _gms_grad_w(offs, interpret, compute_dtype, h, g, src, dst, mask,
+                     w_rel.dtype, int(w_rel.shape[0]))
+    return dh, dw, None, None, None
+
+
+_gms_vjp.defvjp(_gms_vjp_fwd, _gms_vjp_bwd)
+
+
+# -- fused streaming tick: delta-scatter -> message pass -> verdict --------
+
+def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
+                          pn: int, pe: int, num_tiles: int):
+    """Build the fused-tick kernel body for a static (layers, delta,
+    incident, node, edge) shape set. One kernel invocation (no grid —
+    the tile sweep is an in-kernel ``fori_loop``, so the cost model's
+    scan weighting prices each phase exactly once): phase 1 scatters the
+    packed delta into the VMEM-resident mirrors (the aliased outputs,
+    which arrive holding the pre-tick resident state), phase 2 embeds +
+    runs ``num_layers`` relation-bucketed EDGE_TILE sweeps against the
+    resident activations (the per-tile matmul and per-edge accumulate
+    replay ``_gms_kernel``'s exact fold — bit-parity with the composed
+    scatter→gms→score path), phase 3 reduces the incident readout to
+    logits/probs in-kernel. The ``[N, H]`` activations live in VMEM
+    scratch for the whole tick — they never exist as an HBM buffer
+    between stages, which is the modeled bytes/tick floor this kernel
+    exists to lower."""
+    f32 = jnp.float32
+
+    def kernel(*refs):
+        rel_ref, ints_ref, ew_ref, eb_ref, ke_ref, hw_ref, hb_ref = refs[:7]
+        layer_refs = refs[7:7 + 3 * num_layers]
+        feat_ref = refs[7 + 3 * num_layers]
+        # refs[8+3L : 14+3L] are the aliased mirror seed inputs — never
+        # read (the aliased OUTPUT refs below arrive with the same bytes)
+        out0 = 7 + 3 * num_layers + 1 + 6
+        (kind_o, nmask_o, esrc_o, edst_o, erel_o, emask_o,
+         logits_ref, probs_ref) = refs[out0:out0 + 8]
+        h_ref, agg_ref, deg_ref, gath_ref, msg_ref = refs[out0 + 8:]
+
+        # phase 1: delta scatter (drop semantics — the padding sentinel
+        # is out of range, exactly the composed tick's mode="drop")
+        def scat_aux(j, _):
+            idx = ints_ref[j]
+
+            @pl.when(idx < pn)
+            def _():
+                kind_o[idx] = ints_ref[pk + j]
+                nmask_o[idx] = ints_ref[2 * pk + j].astype(f32)
+            return 0
+
+        jax.lax.fori_loop(0, pk, scat_aux, 0)
+        o = 3 * pk
+
+        def scat_edge(j, _):
+            slot = ints_ref[o + j]
+
+            @pl.when(slot < pe)
+            def _():
+                esrc_o[slot] = ints_ref[o + ek + j]
+                edst_o[slot] = ints_ref[o + 2 * ek + j]
+                erel_o[slot] = ints_ref[o + 3 * ek + j]
+                emask_o[slot] = ints_ref[o + 4 * ek + j].astype(f32)
+            return 0
+
+        jax.lax.fori_loop(0, ek, scat_edge, 0)
+
+        # degree over the scattered mirror (sums of 0/1 — exact in any
+        # order, so the per-edge fold bit-matches the XLA segment_sum)
+        deg_ref[:] = jnp.zeros(deg_ref.shape, f32)
+
+        def deg_body(i, _):
+            d = edst_o[i]
+            deg_ref[d] = deg_ref[d] + emask_o[i]
+            return 0
+
+        jax.lax.fori_loop(0, pe, deg_body, 0)
+        degv = deg_ref[:]
+        inv_deg = jnp.where(degv > 0, 1.0 / jnp.maximum(degv, 1.0), 0.0)
+
+        # phase 2: embed, then the relation-bucketed rounds
+        kind_v = kind_o[:]
+        h0 = jax.nn.relu(feat_ref[:] @ ew_ref[:] + eb_ref[:]
+                         + ke_ref[:][kind_v])
+        h_ref[:] = h0 * nmask_o[:][:, None]
+
+        for li in range(num_layers):
+            ws_ref = layer_refs[3 * li]
+            wr_ref = layer_refs[3 * li + 1]
+            b_ref = layer_refs[3 * li + 2]
+            agg_ref[:] = jnp.zeros(agg_ref.shape, f32)
+
+            def tile_body(t, _, wr_ref=wr_ref):
+                base_e = t * EDGE_TILE
+
+                def gather_row(e, _):
+                    gath_ref[e, :] = (h_ref[esrc_o[base_e + e], :]
+                                      * emask_o[base_e + e])
+                    return 0
+
+                jax.lax.fori_loop(0, EDGE_TILE, gather_row, 0)
+                msg_ref[:] = jnp.dot(gath_ref[:], wr_ref[rel_ref[t]],
+                                     preferred_element_type=f32)
+
+                def accum_row(e, _):
+                    d = edst_o[base_e + e]
+                    agg_ref[d, :] = agg_ref[d, :] + msg_ref[e, :]
+                    return 0
+
+                jax.lax.fori_loop(0, EDGE_TILE, accum_row, 0)
+                return 0
+
+            jax.lax.fori_loop(0, num_tiles, tile_body, 0)
+            hv = h_ref[:]
+            aggv = agg_ref[:] * inv_deg[:, None]
+            h_ref[:] = jax.nn.relu(hv @ ws_ref[:] + aggv + b_ref[:]) + hv
+
+        # phase 3: score reduction — readout, logits, masked softmax
+        io = 3 * pk + 5 * ek
+        inc_nodes = ints_ref[io:io + pi]
+        inc_mask = ints_ref[io + pi:io + 2 * pi].astype(f32)
+        logits = h_ref[:][inc_nodes] @ hw_ref[:] + hb_ref[:]
+        logits_ref[:] = logits
+        probs_ref[:] = jax.nn.softmax(logits, axis=-1) * inc_mask[:, None]
+
+    return kernel
+
+
+def _fused_forward(pk, ek, pi, offs, interpret, params, features,
+                   kind, nmask, esrc, edst, erel, emask, ints):
+    num_layers = len(params["layers"])
+    pn = features.shape[0]
+    pe = int(offs[-1])
+    num_tiles = pe // EDGE_TILE
+    hidden = params["embed_b"].shape[0]
+    classes = params["head_b"].shape[0]
+    rel_ids = jnp.asarray(_tile_rel_ids(offs))
+    layer_ops = []
+    for layer in params["layers"]:
+        layer_ops += [layer["w_self"], layer["w_rel"], layer["b"]]
+    inputs = [rel_ids, ints, params["embed_w"], params["embed_b"],
+              params["kind_emb"], params["head_w"], params["head_b"],
+              *layer_ops, features, kind, nmask, esrc, edst, erel, emask]
+    mirror_base = len(inputs) - 6
+    fdt = features.dtype
+    out_shape = [
+        jax.ShapeDtypeStruct((pn,), kind.dtype),
+        jax.ShapeDtypeStruct((pn,), nmask.dtype),
+        jax.ShapeDtypeStruct((pe,), esrc.dtype),
+        jax.ShapeDtypeStruct((pe,), edst.dtype),
+        jax.ShapeDtypeStruct((pe,), erel.dtype),
+        jax.ShapeDtypeStruct((pe,), emask.dtype),
+        jax.ShapeDtypeStruct((pi, classes), fdt),
+        jax.ShapeDtypeStruct((pi, classes), fdt),
+    ]
+    return pl.pallas_call(
+        _fused_kernel_factory(num_layers, pk, ek, pi, pn, pe, num_tiles),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(inputs),
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(out_shape),
+        scratch_shapes=[
+            pltpu.VMEM((pn, hidden), jnp.float32),   # resident activations
+            pltpu.VMEM((pn, hidden), jnp.float32),   # per-layer accumulator
+            pltpu.VMEM((pn,), jnp.float32),          # degree
+            pltpu.VMEM((EDGE_TILE, hidden), jnp.float32),  # gathered rows
+            pltpu.VMEM((EDGE_TILE, hidden), jnp.float32),  # message tile
+        ],
+        # the six resident mirrors alias their outputs: the scatter runs
+        # in place on the donated serving state, never reallocating it
+        input_output_aliases={mirror_base + i: i for i in range(6)},
+        interpret=interpret,
+    )(*inputs)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _fused_vjp(pk, ek, pi, offs, interpret, params, features,
+               kind, nmask, esrc, edst, erel, emask, ints):
+    return _fused_forward(pk, ek, pi, offs, interpret, params, features,
+                          kind, nmask, esrc, edst, erel, emask, ints)
+
+
+def _fused_vjp_fwd(pk, ek, pi, offs, interpret, params, features,
+                   kind, nmask, esrc, edst, erel, emask, ints):
+    out = _fused_forward(pk, ek, pi, offs, interpret, params, features,
+                         kind, nmask, esrc, edst, erel, emask, ints)
+    return out, (params, features, kind, nmask, esrc, edst, erel, emask,
+                 ints)
+
+
+def _fused_vjp_bwd(pk, ek, pi, offs, interpret, res, cts):
+    """Backward of the fused tick: rematerialize the composed
+    scatter→forward→score path over the DIFFERENTIABLE Pallas gms (its
+    own custom_vjp above supplies the transposed-layout backward
+    kernels) and pull the output cotangents through it. Recompute-in-
+    backward is the standard trade: serving pays one fused kernel,
+    training — the rare direction — pays a recompute but stays entirely
+    off the XLA oracle. Gradients flow to params/features/nmask/emask;
+    the int mirrors and the packed delta are layout, not data."""
+    params, features, kind, nmask, esrc, edst, erel, emask, ints = res
+    from ..rca import gnn
+
+    def composed(p, feats, nm, em):
+        f_idx = ints[:pk]
+        kind_v = ints[pk:2 * pk]
+        nmask_v = ints[2 * pk:3 * pk].astype(jnp.float32)
+        o = 3 * pk
+        e_idx = ints[o:o + ek]
+        e_src = ints[o + ek:o + 2 * ek]
+        e_dst = ints[o + 2 * ek:o + 3 * ek]
+        e_rel = ints[o + 3 * ek:o + 4 * ek]
+        e_mask = ints[o + 4 * ek:o + 5 * ek].astype(jnp.float32)
+        o += 5 * ek
+        inc_nodes = ints[o:o + pi]
+        inc_mask = ints[o + pi:o + 2 * pi].astype(jnp.float32)
+        kind2 = kind.at[f_idx].set(kind_v, mode="drop")
+        nm2 = nm.at[f_idx].set(nmask_v, mode="drop")
+        esrc2 = esrc.at[e_idx].set(e_src, mode="drop")
+        edst2 = edst.at[e_idx].set(e_dst, mode="drop")
+        erel2 = erel.at[e_idx].set(e_rel, mode="drop")
+        em2 = em.at[e_idx].set(e_mask, mode="drop")
+        logits = gnn.forward(p, feats, kind2, nm2, esrc2, edst2, erel2,
+                             em2, inc_nodes, rel_offsets=offs,
+                             slices_sorted=False, pallas=True)
+        probs = jax.nn.softmax(logits, axis=-1) * inc_mask[:, None]
+        return nm2, em2, logits, probs
+
+    _, pullback = jax.vjp(composed, params, features, nmask, emask)
+    d_params, d_feats, d_nm, d_em = pullback(
+        (cts[1], cts[5], cts[6], cts[7]))
+    return (d_params, d_feats, None, d_nm, None, None, None, d_em, None)
+
+
+_fused_vjp.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+def pallas_fused_gnn_tick(params, features, kind, nmask, esrc, edst,
+                          erel, emask, ints, *, pk: int, ek: int, pi: int,
+                          rel_offsets, interpret: bool | None = None):
+    """The fused streaming tick (settings.gnn_fused_tick): one
+    ``pallas_call`` applying the packed aux/edge delta to the resident
+    mirrors, running the full relation-bucketed forward against the
+    VMEM-resident activations, and reducing logits/probs in-kernel —
+    the drop-in Pallas replacement for ``rca/gnn_streaming._gnn_tick``'s
+    scatter→forward→score composition (same operand layout, same
+    returns, BIT-identical results; f32 only). Requires a non-empty
+    EDGE_TILE-aligned layout — the dispatcher keeps the composed tick
+    for everything else. Differentiable via ``custom_vjp`` (backward
+    rematerializes the composed path over the Pallas gms backward)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offs = tuple(int(o) for o in rel_offsets or ())
+    if len(offs) < 2 or offs[-1] <= 0 or not tiles_align(offs):
+        raise ValueError(
+            "pallas_fused_gnn_tick needs a non-empty EDGE_TILE-aligned "
+            "relation-bucketed layout (dispatch falls back to the "
+            "composed tick otherwise)")
+    return _fused_vjp(int(pk), int(ek), int(pi), offs, bool(interpret),
+                      params, features, kind, nmask, esrc, edst, erel,
+                      emask, ints)

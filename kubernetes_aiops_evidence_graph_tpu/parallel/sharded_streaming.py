@@ -186,7 +186,8 @@ def sharded_rules_tick(mesh, nodes_per_shard: int, rows_per_shard: int,
 @lru_cache(maxsize=None)
 def sharded_gnn_tick(mesh, nodes_per_shard: int, pe_shard: int, pi: int,
                      pk: int, ek: int, rel_offsets=None,
-                     slices_sorted: bool = False, compute_dtype=None):
+                     slices_sorted: bool = False, compute_dtype=None,
+                     use_pallas: bool = False):
     """Graph-sharded fused GNN streaming tick: the mesh-resident analog of
     rca/gnn_streaming._gnn_tick.
 
@@ -214,9 +215,23 @@ def sharded_gnn_tick(mesh, nodes_per_shard: int, pe_shard: int, pi: int,
     fused gather→matmul→segment kernel as the single-device tick runs
     shard-local. The readout streams incident embeddings out of the ring:
     exactly (LAYERS+1)·D ppermutes of [N/D, H] blocks per tick, zero
-    [N, H] all-gathers, zero psums (CostSpec-pinned)."""
+    [N, H] all-gathers, zero psums (CostSpec-pinned).
+
+    graft-fuse: ``use_pallas=True`` (settings.gnn_fused_tick) promotes
+    the SHARD-LOCAL portion — the per-layer gather→matmul→segment over
+    the assembled rows — to the tiled VMEM-resident Pallas kernel
+    (bit-identical fold), while the halo assembly and the readout ring
+    stay in XLA: the collective census the CostSpec pins is unchanged,
+    only the shard-local lowering is. Layouts off the EDGE_TILE ladder
+    fall back through the Pallas dispatcher's own XLA fallback."""
     from ..ops.segment import gather_matmul_segment
     from ..rca import gnn
+
+    if use_pallas:
+        from ..ops.pallas_segment import pallas_gather_matmul_segment
+        gms_local = pallas_gather_matmul_segment
+    else:
+        gms_local = gather_matmul_segment
 
     g_size = mesh.shape["graph"]
 
@@ -301,7 +316,7 @@ def sharded_gnn_tick(mesh, nodes_per_shard: int, pe_shard: int, pi: int,
         src_iota = jax.lax.iota(jnp.int32, pe_shard)
         for layer in params["layers"]:
             rows = _assemble_ring(h, esrc)
-            agg = gather_matmul_segment(
+            agg = gms_local(
                 rows, layer["w_rel"], src_iota, edst, emask,
                 rel_offsets, nodes_per_shard,
                 slices_sorted=slices_sorted,

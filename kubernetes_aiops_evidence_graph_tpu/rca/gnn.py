@@ -44,10 +44,14 @@ the reference; settings.gnn_pallas promotes serving to the Pallas tier):
   relation id scalar-prefetched, each tile runs one MXU matmul and
   accumulates destination rows against VMEM instead of issuing per-edge
   HBM scatter-adds. BIT-identical to the bucketed kernel (exact-edge-order
-  fold; interpret=True on CPU), forward/serving only — no custom_vjp, so
-  gradients and the training step stay on the XLA bucketed kernel, which
-  remains the parity oracle. BENCH config 3 carries the pallas-vs-XLA A/B
-  record (gnn_forward_pallas_vs_xla).
+  fold; interpret=True on CPU). Since graft-fuse the kernel carries a
+  custom_vjp (transposed-layout Pallas backward), so gradients work on
+  this tier too; the XLA bucketed kernel remains the parity oracle for
+  both directions. The fused streaming tick (settings.gnn_fused_tick)
+  additionally collapses the whole serving tick — delta scatter, message
+  pass, scoring — into one Pallas kernel (ops/pallas_segment.py). BENCH
+  config 3 carries the pallas-vs-XLA A/B record
+  (gnn_forward_pallas_vs_xla) plus the fused-vs-composed record.
 * **Transform-then-gather (reference)** — R stacked MXU matmuls produce
   every relation's transformed copy ([N, R, H] einsum), each edge
   gathers its rel-specific source row, aggregation is one [E, H]
@@ -205,9 +209,14 @@ def forward(
       accumulation stays f32.
     * ``pallas=True`` (requires ``rel_offsets``) dispatches the message
       passing to the tiled VMEM-resident Pallas kernel — the serving
-      tier behind settings.gnn_pallas. Bit-identical logits; FORWARD
-      ONLY (no custom_vjp — gradients raise; training stays on the XLA
-      bucketed kernel). Off-TPU the kernel auto-selects interpret mode.
+      tier behind settings.gnn_pallas. Bit-identical logits, and since
+      graft-fuse DIFFERENTIABLE: the kernel's ``custom_vjp`` runs the
+      transposed-layout Pallas backward (dst-bucketed cotangent scatter
+      + per-relation grad matmuls, f32 accumulation), so training and
+      the online fine-tune (settings.learn_pallas_grads) can run this
+      tier too — grads match ``jax.grad`` of the XLA kernel within f32
+      tolerance (edge ``mask`` is treated as a 0/1 layout constant).
+      Off-TPU the kernel auto-selects interpret mode.
     * ``sorted_by_dst=True`` (reference path only) promises the WHOLE
       edge_dst is non-decreasing, letting every segment-sum take the
       sorted fast path (measured 1.9x on the v5e scatter). Only a
@@ -241,13 +250,15 @@ def loss_fn(
     rel_offsets: tuple[int, ...] | None = None,
     slices_sorted: bool = False,
     compute_dtype: str | None = None,
+    pallas: bool = False,
 ) -> jax.Array:
     """Masked mean cross-entropy over incident rows (static kwargs as in
-    :func:`forward`)."""
+    :func:`forward`). ``pallas=True`` trains through the Pallas kernel's
+    custom_vjp (graft-fuse) — the settings.learn_pallas_grads tier."""
     logits = forward(params, features, node_kind, node_mask,
                      edge_src, edge_dst, edge_rel, edge_mask, incident_nodes,
                      rel_offsets=rel_offsets, slices_sorted=slices_sorted,
-                     compute_dtype=compute_dtype)
+                     compute_dtype=compute_dtype, pallas=pallas)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
     return (nll * label_mask).sum() / jnp.maximum(label_mask.sum(), 1.0)

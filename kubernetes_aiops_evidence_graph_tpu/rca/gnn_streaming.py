@@ -58,7 +58,7 @@ from ..observability import get_logger
 from ..observability import metrics as obs_metrics
 from ..observability import scope as obs_scope
 from .ruleset import NUM_RULES
-from .streaming import StreamingScorer, _DELTA_BUCKETS
+from .streaming import FeatureStage, StreamingScorer, _DELTA_BUCKETS
 from . import gnn
 
 log = get_logger("gnn_streaming")
@@ -125,6 +125,26 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
     return kind, nmask, esrc, edst, erel, emask, logits, probs
 
 
+@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets"),
+         donate_argnums=(2, 3, 4, 5, 6, 7))
+def _gnn_fused_tick(params, features, kind, nmask, esrc, edst, erel, emask,
+                    ints, pk: int, ek: int, pi: int, rel_offsets=None):
+    """graft-fuse: the fused streaming tick (settings.gnn_fused_tick) —
+    the SAME operand layout, donation contract and return tuple as
+    :func:`_gnn_tick`, but delta scatter, message pass and score
+    reduction all run inside ONE Pallas kernel
+    (ops/pallas_segment.pallas_fused_gnn_tick): the [N, H] activations
+    stay VMEM-resident across stages instead of round-tripping through
+    HBM between the scatter, each message-pass layer and the readout.
+    BIT-identical to the composed tick (the parity oracle); f32,
+    EDGE_TILE-aligned bucketed layouts only — the dispatcher keeps the
+    composed tick for every other configuration."""
+    from ..ops.pallas_segment import pallas_fused_gnn_tick
+    return pallas_fused_gnn_tick(params, features, kind, nmask, esrc,
+                                 edst, erel, emask, ints, pk=pk, ek=ek,
+                                 pi=pi, rel_offsets=rel_offsets)
+
+
 class GnnStreamingScorer(StreamingScorer):
     """StreamingScorer + resident edge mirror + per-tick GNN forward.
 
@@ -172,6 +192,16 @@ class GnnStreamingScorer(StreamingScorer):
         # degradation tier (Pallas→XLA on repeated device faults) cannot
         # change verdicts — only the lowering that produces them
         self._use_pallas = bool(getattr(cfg, "gnn_pallas", False))
+        # graft-fuse: the fused streaming tick (settings.gnn_fused_tick) —
+        # delta scatter + message pass + score reduction in ONE Pallas
+        # kernel. Sits ABOVE the pallas tier on the shield's
+        # kernel-fallback rung: fused → composed(pallas/XLA) → XLA, every
+        # hop bit-identical. f32 bucketed layouts only; the dispatcher
+        # falls back to the composed tick otherwise (_fused_ok).
+        self._use_fused = bool(getattr(cfg, "gnn_fused_tick", False))
+        # transient per-dispatch stash: the packed GNN delta the staged
+        # slab should carry (single-transfer satellite; see dispatch)
+        self._gnn_stage = None
         super().__init__(store, settings, mesh=mesh, now_s=now_s)
         # graft-scope: this scorer's ticks and SLO samples are labeled by
         # the backend that actually produced the verdict
@@ -194,6 +224,45 @@ class GnnStreamingScorer(StreamingScorer):
             else None,
             "pallas": self._use_pallas if self._use_bucketed else False,
         }
+
+    def _fused_ok(self, rel_offsets=None) -> bool:
+        """Whether the fused Pallas tick can serve the CURRENT (or given)
+        layout: fused tier on, bucketed f32 math, a non-empty
+        EDGE_TILE-aligned slice table, single-device mirror. Everything
+        else keeps the composed tick — same verdicts, different
+        lowering."""
+        if not (self._use_fused and self._use_bucketed
+                and not self._compute_dtype
+                and not getattr(self, "_mirror_sharded", False)):
+            return False
+        from ..ops.pallas_segment import tiles_align
+        offs = rel_offsets if rel_offsets is not None \
+            else getattr(self, "_rel_offsets", ())
+        return (len(offs) >= 2 and int(offs[-1]) > 0
+                and tiles_align(offs))
+
+    def _call_gnn_tick(self, args: tuple, pk: int, ek: int, pi: int,
+                       rel_offsets=None, slices_sorted=None):
+        """Run (or warm) ONE single-device GNN tick at the given shapes
+        through the tier the settings select — the fused Pallas kernel
+        when the layout admits it, the composed scatter→forward tick
+        otherwise. Single seam so dispatch and every warm path compile
+        exactly the variant serving will run. Returns the 8-tuple."""
+        offs = rel_offsets if rel_offsets is not None \
+            else self._rel_offsets
+        if self._fused_ok(offs):
+            return _gnn_fused_tick(*args, pk=pk, ek=ek, pi=pi,
+                                   rel_offsets=offs)
+        statics = self._tick_statics(rel_offsets=offs,
+                                     slices_sorted=slices_sorted)
+        return _gnn_tick(*args, pk=pk, ek=ek, pi=pi, **statics)
+
+    def _staged_extra_ints(self):
+        """graft-fuse single-transfer satellite: hand the packed GNN
+        delta (prepared by dispatch() BEFORE the base tick stages) to
+        the base scorer's columnar slab, so the GNN tick's ints ride
+        the same host→device transfer as the base delta."""
+        return self._gnn_stage
 
     # -- mirror (re)initialisation ---------------------------------------
 
@@ -561,9 +630,13 @@ class GnnStreamingScorer(StreamingScorer):
 
     def _sharded_tick_fn(self, pk: int, ek: int):
         """The sharded GNN tick for the CURRENT shapes. The sharded path
-        always runs the relation-bucketed XLA kernel: the mirror layout
-        is bucketed regardless, and the Pallas tier stays a single-device
-        lowering (the shield's kernel-fallback rung is a no-op here)."""
+        runs the relation-bucketed XLA kernel by default; with
+        settings.gnn_fused_tick the SHARD-LOCAL gather→matmul→segment
+        portion promotes to the Pallas kernel while the halo assembly
+        stays in XLA (graft-fuse) — the shield's kernel-fallback rung
+        flips ``_use_fused`` off here exactly like the single-device
+        tiers. ``settings.gnn_pallas`` alone keeps the historical
+        single-device-only behavior."""
         from ..parallel.sharded_streaming import sharded_gnn_tick
         g = self._graph_size()
         return sharded_gnn_tick(
@@ -571,7 +644,8 @@ class GnnStreamingScorer(StreamingScorer):
             self.snapshot.padded_incidents, pk, ek,
             rel_offsets=self._rel_offsets,
             slices_sorted=bool(self._slices_sorted),
-            compute_dtype=self._compute_dtype)
+            compute_dtype=self._compute_dtype,
+            use_pallas=bool(self._use_fused))
 
     def _tick_handles(self, out: tuple) -> tuple:
         """The pipeline queue tracks the GNN tick's outputs: in gnn mode
@@ -726,12 +800,24 @@ class GnnStreamingScorer(StreamingScorer):
     def dispatch(self) -> tuple:
         """Base fused tick (shared feature deltas + rules score), then the
         GNN tick on the UPDATED features. Returns the base device handles
-        (unfetched); GNN outputs land in `_last_gnn`."""
+        (unfetched); GNN outputs land in `_last_gnn`.
+
+        graft-fuse: the edge-journal drain and the GNN delta pack now run
+        BEFORE the base dispatch (the drained record set is identical —
+        nothing appends to the store journal mid-dispatch), so on the
+        columnar path the packed GNN ints fold into the base scorer's
+        staged slab (`_staged_extra_ints`) and the whole tick — base
+        delta, feature rows AND the GNN delta — pays ONE host→device
+        transfer (PR 11's named follow-up). The sharded mirror keeps its
+        per-shard [G, L] transfer. With settings.gnn_fused_tick the
+        single-device tick itself runs as one Pallas kernel
+        (`_gnn_fused_tick`)."""
         aux_rows = list(self._pending_feat.keys())
-        out = super().dispatch()
-        span = self._last_tick_span   # opened by the base dispatch
         self._drain_edges()
         if self._mirror_sharded:
+            self._gnn_stage = None
+            out = super().dispatch()
+            span = self._last_tick_span   # opened by the base dispatch
             ints, pk, ek = self._packed_gnn_delta_sharded(aux_rows)
             tick = self._sharded_tick_fn(pk, ek)
             args = (self._params, self._features_dev, self._kind_dev,
@@ -743,20 +829,33 @@ class GnnStreamingScorer(StreamingScorer):
              probs) = tick(*args)
         else:
             ints, pk, ek = self._packed_gnn_delta(aux_rows)
-            statics = self._tick_statics()
+            columnar = isinstance(self._pending_feat, FeatureStage)
+            self._gnn_stage = ints if columnar else None
+            try:
+                out = super().dispatch()
+            finally:
+                self._gnn_stage = None
+            span = self._last_tick_span
+            ints_dev = self._staged_gnn_dev
+            self._staged_gnn_dev = None
+            if ints_dev is None:
+                # dict-oracle path (or a sharded base tick): the GNN
+                # delta pays its own transfer, exactly as before
+                ints_dev = jnp.asarray(ints)
+            pi = self.snapshot.padded_incidents
             args = (self._params, self._features_dev, self._kind_dev,
                     self._nmask_dev, self._esrc_dev, self._edst_dev,
-                    self._erel_dev, self._emask_dev, jnp.asarray(ints))
-            self._scope_gnn(
-                span, False, pk, ek,
-                partial(_gnn_tick, pk=pk, ek=ek,
-                        pi=self.snapshot.padded_incidents, **statics),
-                args)
+                    self._erel_dev, self._emask_dev, ints_dev)
+            if self._fused_ok():
+                scope_tick = partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi,
+                                     rel_offsets=self._rel_offsets)
+            else:
+                scope_tick = partial(_gnn_tick, pk=pk, ek=ek, pi=pi,
+                                     **self._tick_statics())
+            self._scope_gnn(span, False, pk, ek, scope_tick, args)
             (self._kind_dev, self._nmask_dev, self._esrc_dev,
              self._edst_dev, self._erel_dev, self._emask_dev, logits,
-             probs) = _gnn_tick(
-                *args, pk=pk, ek=ek, pi=self.snapshot.padded_incidents,
-                **statics)
+             probs) = self._call_gnn_tick(args, pk, ek, pi)
         self._last_gnn = (self.params_generation, logits, probs)
         if span is not None:
             span.mark("gnn_dispatch")
@@ -771,7 +870,9 @@ class GnnStreamingScorer(StreamingScorer):
         if span is None:
             return
         self._scope_entry = ("streaming.gnn_tick.sharded" if sharded
-                             else "streaming.gnn_tick")
+                             else ("streaming.gnn_tick.fused"
+                                   if self._fused_ok()
+                                   else "streaming.gnn_tick"))
         self._scope_key = (self.snapshot.padded_nodes,
                            self.snapshot.padded_incidents,
                            int(self._esrc_dev.shape[0]), pk, ek, sharded)
@@ -850,8 +951,11 @@ class GnnStreamingScorer(StreamingScorer):
             pe = int(self._esrc_dev.shape[0])
             params = self._params
             features_dev = self._features_dev
-            variants = [self._tick_statics(slices_sorted=ss) for ss in
-                        ((True, False) if self._use_bucketed else (False,))]
+            fused = self._fused_ok()
+            # the fused kernel's fold is order-exact regardless of the
+            # sorted promise — one variant covers both transitions
+            variants = ([None] if fused else
+                        [True, False] if self._use_bucketed else [False])
             inc_n = self.snapshot.incident_nodes.astype(np.int32, copy=True)
             inc_m = self.snapshot.incident_mask.astype(np.int32)
             sharded = bool(getattr(self, "_mirror_sharded", False))
@@ -860,12 +964,15 @@ class GnnStreamingScorer(StreamingScorer):
             offs = self._rel_offsets
             compute_dtype = self._compute_dtype if self._use_bucketed \
                 else None
+            columnar = isinstance(self._pending_feat, FeatureStage)
+            width = self.width
         if sharded:
             self._warm_gnn_sharded(delta_sizes, edge_sizes, pi, pn, g,
                                    pe, pe_shard, offs, compute_dtype,
                                    params, features_dev, inc_n, inc_m)
             return
-        for statics in variants:
+        dim = self.snapshot.features.shape[1]
+        for ss in variants:
             for pk in delta_sizes:
                 for ek in edge_sizes:
                     if self._warm_stop:
@@ -878,15 +985,27 @@ class GnnStreamingScorer(StreamingScorer):
                         np.zeros(ek, np.int32),
                         inc_n, inc_m,
                     ]).astype(np.int32, copy=False)
-                    _gnn_tick(params, features_dev,
-                              jnp.zeros(pn, jnp.int32),
-                              jnp.zeros(pn, jnp.float32),
-                              jnp.zeros(pe, jnp.int32),
-                              jnp.zeros(pe, jnp.int32),
-                              jnp.full((pe,), -1, jnp.int32),
-                              jnp.zeros(pe, jnp.float32),
-                              jnp.asarray(ints), pk=pk, ek=ek,
-                              pi=pi, **statics)
+                    if columnar:
+                        # pre-compile the slab split carrying the GNN
+                        # delta (single-transfer satellite): the live
+                        # dispatch splits [base ints | f_rows | gnn ints]
+                        from .streaming import _ROW_BUCKETS, _delta_pack
+                        gi = ints.size
+                        for rk in _ROW_BUCKETS[:2]:
+                            li = pk + 2 * rk + 2 * rk * width
+                            _delta_pack(
+                                jnp.zeros(li + pk * dim + gi, jnp.int32),
+                                li=li, pk=pk, dim=dim, gi=gi)
+                    self._call_gnn_tick(
+                        (params, features_dev,
+                         jnp.zeros(pn, jnp.int32),
+                         jnp.zeros(pn, jnp.float32),
+                         jnp.zeros(pe, jnp.int32),
+                         jnp.zeros(pe, jnp.int32),
+                         jnp.full((pe,), -1, jnp.int32),
+                         jnp.zeros(pe, jnp.float32),
+                         jnp.asarray(ints)), pk, ek, pi,
+                        slices_sorted=ss)
 
     def _sharded_gnn_standins(self, pn: int, pe: int):
         """Fresh zero stand-ins for the sharded tick's DONATED mirror
@@ -930,7 +1049,8 @@ class GnnStreamingScorer(StreamingScorer):
                     tick = sharded_gnn_tick(
                         self.mesh, nps, pe_shard, pi, pk, ek,
                         rel_offsets=offs, slices_sorted=ss,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype,
+                        use_pallas=bool(self._use_fused))
                     tick(params, features_dev,
                          *self._sharded_gnn_standins(pn, pe),
                          jnp.asarray(ints))
@@ -988,7 +1108,8 @@ class GnnStreamingScorer(StreamingScorer):
                         self.mesh, cpn // g, pe_shard, cpi, pk, ek,
                         rel_offsets=offs, slices_sorted=True,
                         compute_dtype=self._compute_dtype
-                        if self._use_bucketed else None)
+                        if self._use_bucketed else None,
+                        use_pallas=bool(self._use_fused))
                     tick(self._params, feats,
                          *self._sharded_gnn_standins(cpn, cpe),
                          jnp.asarray(ints))
@@ -1002,17 +1123,17 @@ class GnnStreamingScorer(StreamingScorer):
                     np.zeros(ek, np.int32),
                     np.zeros(2 * cpi, np.int32),
                 ]).astype(np.int32, copy=False)
-                _gnn_tick(self._params,
-                          jnp.zeros((cpn, dim), jnp.float32),
-                          jnp.zeros(cpn, jnp.int32),
-                          jnp.zeros(cpn, jnp.float32),
-                          jnp.zeros(cpe, jnp.int32),
-                          jnp.zeros(cpe, jnp.int32),
-                          jnp.full((cpe,), -1, jnp.int32),
-                          jnp.zeros(cpe, jnp.float32),
-                          jnp.asarray(ints), pk=pk, ek=ek, pi=cpi,
-                          **self._tick_statics(rel_offsets=offs,
-                                               slices_sorted=True))
+                self._call_gnn_tick(
+                    (self._params,
+                     jnp.zeros((cpn, dim), jnp.float32),
+                     jnp.zeros(cpn, jnp.int32),
+                     jnp.zeros(cpn, jnp.float32),
+                     jnp.zeros(cpe, jnp.int32),
+                     jnp.zeros(cpe, jnp.int32),
+                     jnp.full((cpe,), -1, jnp.int32),
+                     jnp.zeros(cpe, jnp.float32),
+                     jnp.asarray(ints)), pk, ek, cpi,
+                    rel_offsets=offs, slices_sorted=True)
 
     def warm_serving(self) -> None:
         super().warm_serving()

@@ -537,11 +537,16 @@ class ShieldedScorer:
         State-suspect failures pair every configuration-only rung with a
         journal replay — no config change can restage lost deltas."""
         if step == "kernel_fallback":
-            if not getattr(self.scorer, "_use_pallas", False):
+            # graft-fuse: the fused tick sits ABOVE the Pallas tier on
+            # this rung — fused → composed (Pallas) → XLA, every hop
+            # bit-identical (PR 4 / PR 14): degrading the lowering can
+            # change which kernel faults, never verdicts
+            if getattr(self.scorer, "_use_fused", False):
+                self.scorer._use_fused = False
+            elif getattr(self.scorer, "_use_pallas", False):
+                self.scorer._use_pallas = False
+            else:
                 return False
-            # Pallas -> XLA is bit-identical (PR 4): degrading the
-            # lowering can change which kernel faults, never verdicts
-            self.scorer._use_pallas = False
             self._transition(step)
             if suspect:
                 self._try_recover()

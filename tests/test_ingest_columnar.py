@@ -367,8 +367,9 @@ def test_staged_slab_bit_identical_to_oracle_at_every_rung(rung):
     k = rung if rung == 1 else rung - 3   # land INSIDE the rung
     sc_col, sc_dict = _seeded_scorers(k)
     assert isinstance(sc_col._pending_feat, FeatureStage)
-    slab, f_idx, f_rows, li, pk, rk = sc_col._staged_delta_columnar()
+    slab, f_idx, f_rows, li, pk, rk, gi = sc_col._staged_delta_columnar()
     assert pk == rung
+    assert gi == 0          # the base scorer stages no extra payload
     # oracle drain on the twin scorer
     o_idx, o_rows = sc_dict._pending_feature_delta()
     r_idx, r_ev, r_cnt, r_pair = sc_dict._pending_row_delta()

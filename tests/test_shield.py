@@ -461,6 +461,47 @@ def test_gnn_silent_corruption_caught_by_verdict_finite_guard(gnn_params):
     _assert_bit_parity(out, base, injected, binj)
 
 
+def test_gnn_fused_tick_device_loss_recovers_bit_identical(gnn_params):
+    """graft-fuse: the fused Pallas tick under the same device-loss
+    chaos bar as the composed tiers — recovery must reproduce the
+    unfaulted fused replay bit-identically, AND the unfaulted fused
+    replay must bit-match the composed baseline (the fused tier changes
+    the lowering, never the verdicts)."""
+    cfg = dict(gnn_fused_tick=True)
+    base, bshield, binj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert bshield.recoveries == 0
+    assert bshield.scorer._fused_ok(), "premise: fused tier not engaged"
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss")],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert shield.recoveries >= 1
+    _assert_bit_parity(out, base, injected, binj)
+    composed, cshield, cinj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60)
+    _assert_bit_parity(base, composed, binj, cinj)
+
+
+def test_gnn_fused_kernel_fallback_degrades_to_composed(gnn_params):
+    """The fused tier sits on the shield's kernel_fallback rung: a
+    recovery round flips ``_use_fused`` off (fused → composed,
+    bit-identical) before touching heavier tiers, and serving
+    continues."""
+    t0 = obs_metrics.SHIELD_TIER_TRANSITIONS.value(tier="kernel_fallback")
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss", repeats=3)],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, gnn_fused_tick=True))
+    assert shield.scorer._use_fused is False, \
+        "kernel_fallback did not strip the fused tier"
+    assert obs_metrics.SHIELD_TIER_TRANSITIONS.value(
+        tier="kernel_fallback") > t0
+    assert len(out["incident_ids"]) > 0
+    assert np.isfinite(np.asarray(out["probs"])).all()
+
+
 def test_persistent_gnn_fault_walks_ladder_to_rules_fallback(gnn_params):
     """Every tier fails under a persistent device fault until the GNN
     scorer is shed for the rules scorer — degraded, finite, and still
