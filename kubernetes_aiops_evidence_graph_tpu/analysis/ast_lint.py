@@ -118,6 +118,10 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
     # the snapshot; registered jaxpr entrypoints with zero-collective cost)
     ("rca/shield.py", "_snapshot_pack"): ((), ()),
     ("rca/shield.py", "_snapshot_unpack"): (("layout",), ()),
+    # graft-heal per-shard attestation fold (no donation — the resident
+    # arrays must survive the checksum; registered jaxpr entrypoint
+    # heal.attest_fold with zero-collective cost)
+    ("rca/heal.py", "attest_fold"): (("shards",), ()),
     ("rca/streaming.py", "_tick"): (
         ("padded_incidents", "pair_width", "pk", "rk", "width"),
         (0, 3, 4, 5)),

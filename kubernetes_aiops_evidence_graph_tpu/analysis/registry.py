@@ -585,6 +585,23 @@ def _snapshot_unpack_build():
     return fn, (np.zeros(total, np.int32),)
 
 
+def _attest_fold_build():
+    """graft-heal: the per-shard attestation fold at the canonical
+    resident-state shapes, D=1 (the single-device census — sharded it is
+    the same shard-local fold with only the [shards] result crossing).
+    Bitcast + modular uint32 sums only: zero dot FLOPs, zero collectives
+    by contract — the attestation pass may never grow compute or go
+    distributed implicitly."""
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.heal import attest_fold
+    fn = partial(attest_fold, shards=1)
+    args = (np.zeros((N_NODES, DIM), np.float32),
+            np.zeros(N_NODES, np.int32),
+            np.ones(N_NODES, np.float32))
+    return fn, args
+
+
 def _score_device_build():
     np = _np()
     from ..graph.schema import DIM
@@ -825,6 +842,18 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "state into ONE int32 buffer (one device->host transfer "
               "per snapshot); recovery is pinned by the audit, not "
               "trusted — explicit zero-collective CostSpec",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "heal.attest_fold", _attest_fold_build,
+        InvariantSpec(forbid_primitives=NO_SET_SCATTER,
+                      max_intermediate_bytes=HOT_BUDGET),
+        notes="graft-heal per-shard state attestation: bitcast + "
+              "wraparound-uint32 block sums over the node-addressed "
+              "resident arrays, compared against the host-truth oracle "
+              "at snapshot boundaries to localize silent per-shard "
+              "corruption; zero dot FLOPs and an explicit "
+              "zero-collective CostSpec at D=1 (sharded, the fold stays "
+              "shard-local — no psum)",
         cost=COST_DEFAULT),
     Entrypoint(
         "shield.snapshot_unpack", _snapshot_unpack_build,

@@ -227,6 +227,12 @@ class Settings:
     # ("" -> .kaeg_scope/<pid>)
     scope_flight_records: int = 256
     scope_flight_dir: str = ""
+    # flight-dump retention: repeated shield transitions (exactly what
+    # heal-ladder chaos produces) would otherwise grow the dump dir
+    # without bound — keep only the newest K dumps per directory, prune
+    # older ones (counted in aiops_scope_flight_dumps_pruned_total).
+    # 0 disables pruning.
+    flight_dump_keep: int = 64
 
     # --- TPU-native knobs (new in this framework) ---
     # pipelined serving executor (rca/streaming.py): max ticks in flight
@@ -283,6 +289,28 @@ class Settings:
     # deterministic seeded jitter (workflow/engine.RetryPolicy semantics)
     shield_retry_attempts: int = 2
     shield_retry_backoff_s: float = 0.05
+    # graft-heal (rca/heal.py): elastic shard-loss survival for the
+    # graph-sharded resident serving state. A shard-localized fault feeds
+    # a per-mesh-position CircuitBreaker; mesh_shard_failure_threshold
+    # CONSECUTIVE failures on one position classify it persistently
+    # failed, and the shield's mesh_heal ladder rung (between journal
+    # replay and full rebuild) re-places the resident state onto a
+    # survivor mesh at the largest viable D' < D — rules verdicts
+    # bit-identical to a fresh D' build, GNN verdict-identical (the
+    # graft-fleet contract). After mesh_heal_cooldown_s the dead device's
+    # breaker admits its half-open probe and the mesh re-expands D'→D at
+    # a queue generation boundary. Both directions are WAL-journaled
+    # (crash-mid-heal recovers to a consistent shard count).
+    mesh_heal_enabled: bool = True
+    mesh_shard_failure_threshold: int = 3
+    mesh_heal_cooldown_s: float = 5.0
+    # per-shard state attestation at snapshot generation boundaries: a
+    # jitted checksum fold of the node-addressed resident arrays vs the
+    # host-truth mirrors localizes SILENT per-shard corruption to the one
+    # shard that must heal (repaired in place from host truth — never a
+    # whole-state rebuild) instead of waiting for the nonfinite backstop
+    # to catch a wrong verdict.
+    mesh_attest: bool = True
     # graft-evolve (learn/): the online learning loop — production
     # verdicts (verification outcomes, operator HypothesisFeedback,
     # rule-confirmed verdicts) harvested into labeled episodes, a

@@ -436,6 +436,36 @@ SHIELD_NONFINITE_VERDICTS = REGISTRY.counter(
     "Verdict fetches rejected by the finite guard (NaN/inf would have "
     "been served), by path label")
 
+# graft-heal instrumentation (rca/heal.py + the shield's mesh_heal rung):
+# per-shard health, live resharding and re-expansion of the serving mesh.
+MESH_SHARD_HEALTH = REGISTRY.gauge(
+    "aiops_mesh_shard_health",
+    "Per-shard health verdict (1 healthy, 0 classified failed / "
+    "excluded), by shard label (mesh position while live, global device "
+    "index once excluded)")
+MESH_SHARD_FAILURES = REGISTRY.counter(
+    "aiops_mesh_shard_failures_total",
+    "Shard-localized faults fed into the per-position classifier, by "
+    "shard label")
+MESH_HEALS = REGISTRY.counter(
+    "aiops_mesh_heals_total",
+    "Live D→D' reshards onto a survivor mesh (the mesh_heal ladder rung)")
+MESH_REEXPANSIONS = REGISTRY.counter(
+    "aiops_mesh_reexpansions_total",
+    "D'→D re-expansions after a successful half-open device probe")
+MESH_SERVING_SHARDS = REGISTRY.gauge(
+    "aiops_mesh_serving_shards",
+    "Graph shards the resident serving state currently spans (1 = "
+    "single-device fallback)")
+MESH_ATTEST_MISMATCH = REGISTRY.counter(
+    "aiops_mesh_attest_mismatch_total",
+    "Per-shard attestation checksum mismatches (silent corruption "
+    "localized to its shard), by shard label")
+MESH_ATTEST_REPAIRS = REGISTRY.counter(
+    "aiops_mesh_attest_repairs_total",
+    "Attestation repair passes that re-uploaded mismatched shard blocks "
+    "from the host-truth mirrors (no whole-state rebuild)")
+
 # graft-evolve instrumentation (learn/): the online learning loop.
 # Every stage of the verdicts→checkpoint pipeline is counted — harvested
 # episodes, buffer occupancy, fine-tune steps, the gate's eval accuracy,
@@ -495,6 +525,10 @@ SCOPE_FLIGHT_DUMPS = REGISTRY.counter(
     "aiops_scope_flight_dumps_total",
     "Flight-recorder dumps written, by reason label (shield tier "
     "transitions and recoveries)")
+SCOPE_FLIGHT_DUMPS_PRUNED = REGISTRY.counter(
+    "aiops_scope_flight_dumps_pruned_total",
+    "Old flight-recorder dump files pruned by the retention policy "
+    "(settings.flight_dump_keep newest kept per directory)")
 SCOPE_VERDICTS_OBSERVED = REGISTRY.counter(
     "aiops_scope_verdicts_observed_total",
     "Webhook→verdict latency samples observed, by backend label")

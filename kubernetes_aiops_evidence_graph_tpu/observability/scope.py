@@ -194,10 +194,15 @@ class FlightRecorder:
     JSON file — called by the shield on every degradation transition or
     recovery, so the forensic window around a fault is always on disk."""
 
-    def __init__(self, capacity: int = 256) -> None:
+    def __init__(self, capacity: int = 256, retention: int = 64) -> None:
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self.dumps = 0
+        self.pruned = 0
+        # on-disk dump retention: repeated shield transitions (heal-ladder
+        # chaos is exactly that) must not grow the dump dir without bound
+        # — keep the newest K per directory (settings.flight_dump_keep)
+        self.retention = int(retention)
         self.last_dump: dict | None = None
         self.last_dump_path: str | None = None
 
@@ -205,6 +210,9 @@ class FlightRecorder:
         with self._lock:
             if self._ring.maxlen != capacity:
                 self._ring = collections.deque(self._ring, maxlen=capacity)
+
+    def set_retention(self, keep: int) -> None:
+        self.retention = int(keep)
 
     def record(self, rec) -> None:
         """Append one record — a plain dict, or a finalized TickSpan
@@ -253,9 +261,37 @@ class FlightRecorder:
             return None
         with self._lock:
             self.last_dump_path = path
+        self._prune_dumps(d)
         log.warning("flight_recorder_dumped", reason=reason, path=path,
                     records=len(doc["records"]))
         return path
+
+    def _prune_dumps(self, directory: str) -> None:
+        """Retention: keep the newest ``retention`` dump files in this
+        directory (by mtime — dump numbering restarts across processes),
+        remove the rest. Best-effort: a prune failure must never take
+        the recovery path down."""
+        keep = self.retention
+        if keep <= 0:
+            return
+        try:
+            paths = [os.path.join(directory, f)
+                     for f in os.listdir(directory)
+                     if f.startswith("flight_") and f.endswith(".json")]
+            # mtime first (dump numbering restarts across processes),
+            # name as the tiebreak (same-process dumps can land within
+            # one timestamp granule)
+            paths.sort(key=lambda p: (os.path.getmtime(p), p))
+        except OSError:
+            return
+        for p in paths[:-keep] if len(paths) > keep else []:
+            try:
+                os.remove(p)
+            except OSError:
+                continue
+            with self._lock:
+                self.pruned += 1
+            m.SCOPE_FLIGHT_DUMPS_PRUNED.inc()
 
 
 def _default_flight_dir() -> str:
@@ -426,6 +462,8 @@ class TickScope:
         self._stage_keys: dict[str, tuple] = {}
         FLIGHT_RECORDER.resize(
             int(getattr(settings, "scope_flight_records", 256)))
+        FLIGHT_RECORDER.set_retention(
+            int(getattr(settings, "flight_dump_keep", 64)))
 
     def _stage_key(self, stage: str) -> tuple:
         k = self._stage_keys.get(stage)

@@ -59,18 +59,39 @@ def ensure_host_devices(n: int) -> bool:
     return True
 
 
-def serving_mesh(graph: int, devices=None) -> "Mesh | None":
+class MeshUnavailable(RuntimeError):
+    """``serve_graph_shards`` exceeds what the device pool (after the
+    forced-host-device fallback) can carry. Raised only on the strict
+    path — the serving scorer keeps its logged single-device fallback,
+    but callers that must not silently degrade (benches, heal planning,
+    operators asserting a fleet) get a clear error instead of a
+    misshaped or missing mesh."""
+
+
+def serving_mesh(graph: int, devices=None,
+                 strict: bool = False) -> "Mesh | None":
     """(1 x graph) serving mesh for the graph-sharded streaming scorer
     (settings.serve_graph_shards). None when the device pool cannot carry
     the axis — callers fall back to single-device serving (logged by the
-    scorer, never silent)."""
+    scorer, never silent). ``strict=True`` raises
+    :class:`MeshUnavailable` (with the requested vs available counts)
+    instead of returning None."""
     if graph <= 1:
         return None
     if devices is None:
         if not ensure_host_devices(graph):
+            if strict:
+                raise MeshUnavailable(
+                    f"serve_graph_shards={graph} exceeds the "
+                    f"{len(jax.devices())} available devices (forced-host "
+                    "fallback cannot mint devices after backend init)")
             return None
         devices = jax.devices()
     if len(devices) < graph:
+        if strict:
+            raise MeshUnavailable(
+                f"serve_graph_shards={graph} exceeds the {len(devices)} "
+                "available devices")
         return None
     arr = np.asarray(devices[:graph]).reshape(1, graph)
     return Mesh(arr, axis_names=("dp", "graph"))

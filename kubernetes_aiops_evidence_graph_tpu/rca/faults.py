@@ -27,6 +27,14 @@ Stages (where the hooks fire):
                        feature rows (the finite guard must quarantine)
 * ``stall``          — the tick completes but only after sleeping past the
                        watchdog timeout (fires at the ``execute`` hook)
+* ``shard_loss``     — graft-heal: a PER-SHARD device fault on the
+                       graph-sharded resident state. ``kind="shard_loss"``
+                       corrupts exactly one shard's node block and raises
+                       with the mesh position attached (the shield's
+                       shard-loss classifier keys on it);
+                       ``kind="shard_corrupt_silent"`` corrupts the block
+                       and returns — the class only the per-shard
+                       attestation fold can localize before it serves
 
 graft-storm widened the harness past the tick path — the ingest and
 learner paths previously had ZERO fault coverage:
@@ -63,7 +71,8 @@ from ..observability import get_logger
 log = get_logger("shield.faults")
 
 TICK_STAGES = ("staging", "dispatch", "pack", "execute", "fetch",
-               "journal_append", "snapshot_write", "delta_values")
+               "journal_append", "snapshot_write", "delta_values",
+               "shard_loss")
 # graft-storm: the previously-uncovered halves of the pipeline
 INGEST_STAGES = ("parse", "dedup", "persist", "admit")
 LEARN_STAGES = ("harvest", "swap")
@@ -112,19 +121,28 @@ class InjectedFault(RuntimeError):
     ``dispatch``/``execute`` mean staged deltas or the donated state
     itself are gone and only journal-replay recovery restores parity."""
 
-    def __init__(self, stage: str, kind: str, visit: int):
-        super().__init__(f"injected {kind} fault at {stage} (visit {visit})")
+    def __init__(self, stage: str, kind: str, visit: int,
+                 shard: "int | None" = None):
+        msg = f"injected {kind} fault at {stage} (visit {visit})"
+        if shard is not None:
+            msg += f" [shard {shard}]"
+        super().__init__(msg)
         self.stage = stage
         self.kind = kind
         self.visit = visit
+        # graft-heal: mesh position the fault is localized to (None =
+        # not shard-attributable) — the shield's classifier reads this
+        self.shard = shard
 
 
 @dataclass(frozen=True)
 class Fault:
     stage: str          # one of STAGES
     at: int             # fires on the Nth visit of the stage (0-based)
-    kind: str = "raise"  # raise | device_loss | corrupt_silent | poison | stall
+    kind: str = "raise"  # raise | device_loss | corrupt_silent | poison |
+    #                      stall | shard_loss | shard_corrupt_silent
     repeats: int = 1    # consecutive visits that fail (escalation depth)
+    shard: int = 0      # graft-heal: target mesh position for shard kinds
 
 
 class FaultInjector:
@@ -143,18 +161,27 @@ class FaultInjector:
     @classmethod
     def seeded(cls, seed: int, ticks: int, rate: float = 0.25,
                stages: tuple[str, ...] = STAGES,
-               stall_seconds: float = 0.0) -> "FaultInjector":
+               stall_seconds: float = 0.0,
+               shards: int = 0) -> "FaultInjector":
         """Randomized-but-reproducible schedule: each stage draws fault
         visits over ``[0, ticks)`` at ``rate``. The same seed always
         yields the same schedule — chaos runs log the seed so any failure
-        reproduces exactly."""
+        reproduces exactly. ``shards`` > 0 widens the pool with per-shard
+        kinds: ``shard_loss`` draws target a random mesh position, and
+        half of them go SILENT (corruption only the attestation fold can
+        localize)."""
         rng = np.random.default_rng(seed)
         faults: list[Fault] = []
         for stage in stages:
             hits = rng.random(ticks) < rate
             for at in np.nonzero(hits)[0]:
+                shard = 0
                 if stage == "delta_values":
                     kind = "poison"
+                elif stage == "shard_loss":
+                    kind = ("shard_corrupt_silent"
+                            if rng.random() < 0.5 else "shard_loss")
+                    shard = int(rng.integers(0, max(shards, 1)))
                 elif stage == "execute" and rng.random() < 0.5:
                     kind = "device_loss"
                 elif stage in WORKFLOW_STAGES:
@@ -163,7 +190,8 @@ class FaultInjector:
                     kind = "crash"
                 else:
                     kind = "raise"
-                faults.append(Fault(stage=stage, at=int(at), kind=kind))
+                faults.append(Fault(stage=stage, at=int(at), kind=kind,
+                                    shard=shard))
         return cls(faults, stall_seconds=stall_seconds)
 
     def _due(self, stage: str) -> "Fault | None":
@@ -196,6 +224,16 @@ class FaultInjector:
             # catch it before garbage serves
             self._corrupt_resident(scorer)
             return
+        if f.kind == "shard_corrupt_silent" and scorer is not None:
+            # graft-heal: SILENT single-shard corruption — the rules fold
+            # absorbs NaN through threshold compares, so only the
+            # per-shard attestation fold at the next snapshot boundary
+            # can localize (and repair) it before a wrong verdict serves
+            self._corrupt_shard(scorer, f.shard)
+            return
+        if f.kind == "shard_loss" and scorer is not None:
+            shard = self._corrupt_shard(scorer, f.shard)
+            raise InjectedFault(stage, f.kind, visit, shard=shard)
         if f.kind == "device_loss" and scorer is not None:
             self._corrupt_resident(scorer)
         raise InjectedFault(stage, f.kind, visit)
@@ -223,6 +261,25 @@ class FaultInjector:
         self.at(stage)
 
     # -- corruption --------------------------------------------------------
+
+    @staticmethod
+    def _corrupt_shard(scorer: Any, shard: int) -> int:
+        """graft-heal: kill exactly ONE mesh position's node block — the
+        feature rows owned by that shard go NaN while every other block
+        stays bit-intact, so (a) the shard-loss classifier can localize
+        the fault and (b) the attestation fold must flag exactly one
+        shard. Returns the (wrapped) position actually corrupted."""
+        import jax.numpy as jnp
+        feats = getattr(scorer, "_features_dev", None)
+        if feats is None:
+            return 0
+        g = max(int(scorer._graph_size()), 1) \
+            if hasattr(scorer, "_graph_size") else 1
+        shard = int(shard) % g
+        rows = feats.shape[0] // g
+        scorer._features_dev = feats.at[
+            shard * rows:(shard + 1) * rows].set(jnp.nan)
+        return shard
 
     @staticmethod
     def _corrupt_resident(scorer: Any) -> None:

@@ -786,6 +786,40 @@ class GnnStreamingScorer(StreamingScorer):
         self._erel_dev = jax.device_put(self._erel_dev, gsh)
         self._emask_dev = jax.device_put(self._emask_dev, gsh)
 
+    def adopt_mesh(self, mesh) -> None:
+        """graft-heal: live resharding for the GNN scorer. The base
+        reshard re-derives features/evidence from host truth at the new
+        placement; the edge mirror additionally RE-BUCKETS — the
+        per-(shard, relation) regions, shard-local dst rows and shared
+        static offsets are all functions of D, so ``_mirror_init``
+        re-places every edge on its dst-owner shard under the new mesh
+        (re-deriving the shared region capacities the partition.py way)
+        and resets kind/nmask from the host-truth snapshot. A freshly
+        re-mirrored layout is dst-sorted, exactly what a fresh D' build
+        lays out — which is why post-heal GNN serving is
+        verdict-identical to a fresh D' build (the graft-fleet churn
+        contract: slot-reuse history differs, per-dst sums reorder at
+        float tolerance)."""
+        if mesh is not None and (
+                "graph" not in getattr(mesh, "axis_names", ())
+                or mesh.shape["graph"] <= 1):
+            mesh = None
+        # the OLD-layout edge regions cannot be placed on the NEW mesh
+        # (their stacked slot space is sized for the old D): drop them so
+        # the base reshard's _apply_sharding skips the mirror, then
+        # rebuild at the new layout
+        self._esrc_dev = None
+        super().adopt_mesh(mesh)
+        self._mirror_init()
+
+    def _attest_arrays(self) -> list:
+        # the aux mirrors are node-addressed with exact host truth; the
+        # edge regions are NOT attested per shard (their slot layout is
+        # allocation history, re-derivable but not host-mirrored row-wise)
+        return super()._attest_arrays() + [
+            ("_kind_dev", self.snapshot.node_kind),
+            ("_nmask_dev", self.snapshot.node_mask)]
+
     def _pending_delta_count(self) -> int:
         # each pending edge entry is one directed slot in the packed
         # delta; in sharded mode the compiled width follows the MAX

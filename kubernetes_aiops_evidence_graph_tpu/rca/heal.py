@@ -1,0 +1,283 @@
+"""graft-heal: elastic shard-loss survival for the resident serving mesh.
+
+graft-fleet (PR 7) sharded the resident serving state across a ``(1 x D)``
+mesh and graft-shield (PR 6) made it crash-consistent — but the two never
+composed into device-level fault tolerance: a dead chip in the mesh was
+indistinguishable from total state loss, and the shield's only rungs were
+journal replay at the *same* D or a full store rebuild. This module is the
+missing composition, four pieces:
+
+1. **Shard-loss classification** (:class:`ShardHealthTracker`). A
+   per-mesh-position :class:`~..ingestion.admission.CircuitBreaker`:
+   transient device faults reset on the next clean pass (the existing
+   retry/replay rungs handle them), while N consecutive failures
+   localized to ONE mesh position open that position's breaker — the
+   "persistently failed shard" verdict the shield's new ``mesh_heal``
+   rung keys on. Health is surfaced in the ``aiops_mesh_*`` gauges and
+   the flight ring.
+
+2. **Reshard planning** (:func:`plan_reshard` / :func:`survivor_mesh`).
+   D' is the largest shard count below D that (a) the survivor device
+   pool can carry and (b) the padded node bucket divides over — the same
+   divisibility contract ``StreamingScorer._graph_sharded`` already
+   enforces, so the healed state is exactly the state a fresh D' build
+   would shard. D' = 1 degrades to single-device serving (mesh ``None``),
+   the graceful floor.
+
+3. **Per-shard state attestation** (:func:`attest_fold` /
+   :func:`attest_host`). A jitted modular-checksum fold over the
+   node-addressed resident arrays, computed per shard block and compared
+   against the SAME fold of the host-truth mirrors at snapshot
+   generation boundaries — silent per-shard corruption (the fault class
+   today's whole-state nonfinite backstop can only catch after it serves
+   a wrong verdict) is detected and localized to the one shard that must
+   heal. Registered audit entrypoint (``heal.attest_fold``) with a
+   zero-collective CostSpec at D=1; when sharded the fold is one small
+   per-shard reduce, no psum.
+
+4. **Re-expansion.** The failed device's breaker cools down into its
+   half-open probe; the shield grows D' back to D at a queue generation
+   boundary (graft-evolve's hot-swap discipline: the flip happens under
+   ``serve_lock``, in-flight ticks complete on the old mesh and are
+   superseded) and the probe either closes the breaker on the next clean
+   pass or re-opens it — one failure after a probe re-heals immediately.
+
+Both the heal and the re-expansion are WAL-journaled (``mesh_heal``
+records carry a monotonic ``heal_gen``) BEFORE they apply, so a crash at
+any point recovers to a consistent shard count: the snapshot records the
+mesh shape it was captured at, and replay re-applies any newer heal
+records in file order (rca/shield.py).
+"""
+from __future__ import annotations
+
+import threading
+from functools import lru_cache, partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ingestion.admission import CircuitBreaker
+from ..observability import get_logger
+from ..observability import metrics as obs_metrics
+from ..observability import scope as obs_scope
+
+log = get_logger("heal")
+
+
+# -- reshard planning -------------------------------------------------------
+
+def plan_reshard(padded_nodes: int, shards: int, survivors: int) -> int:
+    """Largest viable shard count D' < ``shards``: the survivor pool must
+    carry it and the padded node bucket must divide over it (the
+    ``_graph_sharded`` contract — a non-dividing D' would silently fall
+    back to single-device, which the plan makes explicit instead by
+    skipping it). Returns 1 (single-device serving, mesh ``None``) when
+    no sharded layout survives."""
+    for d in range(min(int(shards) - 1, int(survivors)), 1, -1):
+        if padded_nodes % d == 0:
+            return d
+    return 1
+
+
+@lru_cache(maxsize=None)
+def survivor_mesh(shards: int, exclude: tuple[int, ...] = ()):
+    """(1 x shards) serving mesh over the device pool MINUS the excluded
+    device indices (the classified-dead chips). ``None`` when shards <= 1
+    (single-device serving) or the survivor pool cannot carry the axis.
+    Cached per (shards, exclude) so a heal→re-expand cycle back to the
+    same layout reuses the mesh object (and through it the lru-cached
+    compiled ticks)."""
+    if shards <= 1:
+        return None
+    from jax.sharding import Mesh
+    dead = set(int(i) for i in exclude)
+    devices = [d for i, d in enumerate(jax.devices()) if i not in dead]
+    if len(devices) < shards:
+        return None
+    arr = np.asarray(devices[:shards]).reshape(1, shards)
+    return Mesh(arr, axis_names=("dp", "graph"))
+
+
+def device_index(device) -> int:
+    """Global index of ``device`` in the process device pool — the stable
+    identity health/exclusion bookkeeping is keyed by (mesh positions
+    shift across heals; devices do not)."""
+    for i, d in enumerate(jax.devices()):
+        if d == device:
+            return i
+    raise ValueError(f"device {device} not in the local pool")
+
+
+# -- per-shard state attestation --------------------------------------------
+
+@partial(jax.jit, static_argnames=("shards",))
+def attest_fold(*arrays, shards: int):
+    """Per-shard modular checksum of node-addressed resident arrays:
+    float tables bitcast to int32 (bit-exact — NaN payloads included, so
+    a poisoned block can never checksum clean), each array reshaped into
+    its ``shards`` contiguous node blocks and folded with a wraparound
+    uint32 sum (commutative — shard-local accumulation order is free).
+    Returns ``[num_arrays, shards]`` uint32. At D=1 this is one
+    whole-state fold with zero collectives (the registered CostSpec);
+    sharded, each block's fold is shard-local — no psum, only the tiny
+    [shards] result leaves the device."""
+    sums = []
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            a = jax.lax.bitcast_convert_type(
+                a.astype(jnp.float32), jnp.int32)
+        blocks = a.astype(jnp.int32).reshape(shards, -1).astype(jnp.uint32)
+        sums.append(blocks.sum(axis=1, dtype=jnp.uint32))
+    return jnp.stack(sums)
+
+
+def attest_host(arrays, shards: int) -> np.ndarray:
+    """Host-side oracle of :func:`attest_fold` over the host-truth
+    mirrors — the comparison baseline (the host copies are authoritative
+    and bit-identical to the device state by the streaming mirror
+    contract, rca/streaming.capture_host_state)."""
+    out = []
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        if a.dtype.kind == "f":
+            a = np.ascontiguousarray(a.astype(np.float32)).view(np.int32)
+        else:
+            a = a.astype(np.int32)
+        blocks = a.reshape(shards, -1).astype(np.uint32)
+        out.append(blocks.sum(axis=1, dtype=np.uint32))
+    return np.stack(out)
+
+
+# -- shard-loss classification ----------------------------------------------
+
+class ShardHealthTracker:
+    """Per-mesh-position failure classification over the existing
+    CircuitBreaker machinery (graft-storm).
+
+    ``record_failure(pos)`` feeds a shard-localized fault into that
+    position's breaker: N consecutive failures open it — the
+    "persistently failed shard" verdict (:meth:`failed_position`). A
+    clean guarded pass resets every live breaker (transient faults never
+    accumulate across healthy ticks). On heal, the failed position's
+    breaker moves to the EXCLUDED table keyed by its global device index
+    (positions shift with the mesh; devices do not) where its cooldown
+    gates the re-expansion probe: ``can_reexpand()`` is the half-open
+    transition, and after :meth:`note_reexpanded` the probing breaker
+    rides the device's new mesh position half-open — one more failure
+    re-opens it (immediate re-heal), one clean pass closes it."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0) -> None:
+        self.failure_threshold = max(int(failure_threshold), 1)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._live: dict[int, CircuitBreaker] = {}       # mesh position
+        self._excluded: dict[int, CircuitBreaker] = {}   # device index
+        self.shard_failures = 0
+
+    def _breaker(self, pos: int) -> CircuitBreaker:
+        b = self._live.get(pos)
+        if b is None:
+            b = self._live[pos] = CircuitBreaker(
+                f"mesh_shard_{pos}",
+                failure_threshold=self.failure_threshold,
+                cooldown_s=self.cooldown_s)
+        return b
+
+    def record_failure(self, pos: int) -> str:
+        """One shard-localized fault at mesh position ``pos``; returns
+        the breaker state after recording (``open`` = classified)."""
+        pos = int(pos)
+        with self._lock:
+            b = self._breaker(pos)
+        b.record_failure()
+        self.shard_failures += 1
+        obs_metrics.MESH_SHARD_FAILURES.inc(shard=str(pos))
+        obs_metrics.MESH_SHARD_HEALTH.set(
+            0.0 if b.state == "open" else 1.0, shard=str(pos))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "shard_fault", shard=pos, state=b.state,
+            failures=b.failures)
+        return b.state
+
+    def record_clean_pass(self) -> None:
+        """A guarded pass with zero failures: consecutive-failure counts
+        reset (transient ≠ persistent), half-open probes close, and
+        fully-healthy breakers are pruned."""
+        with self._lock:
+            live = list(self._live.items())
+        for pos, b in live:
+            closing = b.state == "half_open"
+            b.record_success()
+            obs_metrics.MESH_SHARD_HEALTH.set(1.0, shard=str(pos))
+            if closing:
+                obs_scope.FLIGHT_RECORDER.note_event(
+                    "shard_probe_closed", shard=pos)
+            with self._lock:
+                if b.state == "closed" and b.failures == 0:
+                    self._live.pop(pos, None)
+
+    def failed_position(self, exclude: tuple[int, ...] = ()) -> "int | None":
+        """First mesh position classified as persistently failed (breaker
+        open), skipping positions already excluded by a prior heal."""
+        with self._lock:
+            for pos in sorted(self._live):
+                if pos in exclude:
+                    continue
+                if self._live[pos].state == "open":
+                    return pos
+        return None
+
+    def exclude(self, pos: int, dev_idx: int) -> None:
+        """Heal applied: move the failed position's breaker to the
+        excluded table under its global device index and reset the live
+        position space (positions shift with the new mesh)."""
+        with self._lock:
+            b = self._live.pop(int(pos), None)
+            self._live.clear()
+            if b is None:
+                b = CircuitBreaker(
+                    f"mesh_device_{dev_idx}",
+                    failure_threshold=self.failure_threshold,
+                    cooldown_s=self.cooldown_s)
+                b.record_failure()
+                for _ in range(self.failure_threshold - 1):
+                    b.record_failure()
+            self._excluded[int(dev_idx)] = b
+        obs_metrics.MESH_SHARD_HEALTH.set(0.0, shard=str(dev_idx))
+
+    def excluded_devices(self) -> tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._excluded))
+
+    def can_reexpand(self) -> bool:
+        """True when EVERY excluded device's breaker admits its half-open
+        probe (cooldown elapsed) — the re-expansion gate."""
+        with self._lock:
+            excluded = list(self._excluded.values())
+        # a breaker already sitting half-open (its probe admitted on an
+        # earlier poll that another device then vetoed) counts as ready —
+        # allow() alone would wedge multi-device re-expansion forever
+        return bool(excluded) and all(
+            b.state == "half_open" or b.allow() for b in excluded)
+
+    def note_reexpanded(self, dev_to_pos: dict[int, int]) -> None:
+        """Re-expansion applied: the probing breakers ride their devices'
+        new mesh positions half-open — the next clean pass closes them,
+        the next failure re-opens (immediate re-heal, no fresh N-count)."""
+        with self._lock:
+            for dev, b in list(self._excluded.items()):
+                pos = dev_to_pos.get(dev)
+                if pos is not None:
+                    self._live[pos] = b
+            self._excluded.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "live": {p: b.state for p, b in self._live.items()},
+                "excluded": {d: b.state for d, b in self._excluded.items()},
+                "shard_failures": self.shard_failures,
+            }

@@ -206,7 +206,8 @@ class DeltaJournal:
         return batches, torn
 
     def compact(self, through_seq: int,
-                through_params_gen: "int | None" = None) -> None:
+                through_params_gen: "int | None" = None,
+                through_heal_gen: "int | None" = None) -> None:
         """Drop batches fully covered by a snapshot at ``through_seq``
         (rewrite-and-replace, atomic): after a snapshot the prefix is dead
         weight and replay cost must stay O(suffix), not O(history).
@@ -219,13 +220,22 @@ class DeltaJournal:
         records at generations the snapshot already carries
         (``<= through_params_gen``) are dead weight; newer ones survive.
         ``None`` keeps every swap record (a shield that never learned the
-        snapshot's generation must not guess)."""
+        snapshot's generation must not guess). ``mesh_heal`` records
+        (graft-heal: a live reshard/re-expansion journaled ahead of its
+        application) follow the identical discipline on their own
+        monotonic ``heal_gen``."""
+
+        def _keep(b) -> bool:
+            if b.kind == "params_swap":
+                return (through_params_gen is None
+                        or b.meta.get("generation", 0) > through_params_gen)
+            if b.kind == "mesh_heal":
+                return (through_heal_gen is None
+                        or b.meta.get("heal_gen", 0) > through_heal_gen)
+            return b.seq_hi > through_seq
+
         batches, _ = self.read()
-        keep = [b for b in batches
-                if (b.meta.get("generation", 0) > through_params_gen
-                    if b.kind == "params_swap"
-                    and through_params_gen is not None
-                    else b.seq_hi > through_seq or b.kind == "params_swap")]
+        keep = [b for b in batches if _keep(b)]
         tmp = self.wal_path + ".tmp"
         with open(tmp, "wb") as f:
             for b in keep:

@@ -80,8 +80,13 @@ log = get_logger("shield")
 _RETRIABLE_STAGES = frozenset(
     {"staging", "journal_append", "snapshot_write", "fetch"})
 
-# the degradation ladder, in escalation order
-LADDER = ("kernel_fallback", "sync_depth1", "journal_replay",
+# the degradation ladder, in escalation order. graft-heal slots the
+# ``mesh_heal`` rung between journal replay and the full rebuild: once
+# the per-shard classifier (rca/heal.ShardHealthTracker) has declared a
+# mesh position persistently failed, replaying onto the SAME mesh is
+# futile — the state re-places onto a survivor mesh at D' < D instead,
+# strictly cheaper than the store-derived rebuild.
+LADDER = ("kernel_fallback", "sync_depth1", "journal_replay", "mesh_heal",
           "full_rebuild", "rules_fallback")
 
 
@@ -210,6 +215,25 @@ class ShieldedScorer:
             cooldown_s=getattr(self.settings, "breaker_cooldown_s", 2.0))
         self.breaker_skips = 0
         self._last_run_failures = 0
+        # graft-heal: per-shard health classification + live-reshard
+        # bookkeeping. ``_mesh_home`` is the shard count the scorer was
+        # built at (the re-expansion target); ``_mesh_excluded`` the
+        # global device indices currently healed AROUND; ``_heal_gen``
+        # the monotonic generation every journaled mesh_heal record
+        # carries (compaction and replay order key on it, exactly the
+        # params_swap discipline).
+        from .heal import ShardHealthTracker
+        self.health = ShardHealthTracker(
+            failure_threshold=getattr(
+                self.settings, "mesh_shard_failure_threshold", 3),
+            cooldown_s=getattr(self.settings, "mesh_heal_cooldown_s", 5.0))
+        self._mesh_home = scorer._graph_size()
+        self._mesh_excluded: tuple[int, ...] = ()
+        self._heal_gen = 0
+        self.heals = 0
+        self.reexpansions = 0
+        self.attest_repairs = 0
+        self.last_heal_seconds = 0.0
 
     # -- delegation --------------------------------------------------------
 
@@ -235,6 +259,7 @@ class ShieldedScorer:
 
     def rescore(self, newest: bool = False) -> dict:
         with self._lock:
+            self._maybe_reexpand()
             if newest:
                 return self._run_with_recovery(
                     lambda: self._tick_rescore(newest=True))
@@ -247,6 +272,7 @@ class ShieldedScorer:
         per webhook — and the deltas stay in the store journal for the
         half-open probe (or any verdict-boundary call) to drain."""
         with self._lock:
+            self._maybe_reexpand()
             if not self.breaker.allow():
                 return self._breaker_skip()
             try:
@@ -449,6 +475,12 @@ class ShieldedScorer:
                 continue
             self._watchdog(time.perf_counter() - t0)
             self._last_run_failures = state["failures"]
+            if state["failures"] == 0:
+                # a CLEAN pass (not one that limped through recovery):
+                # transient shard faults reset, half-open probes close —
+                # the transient/persistent distinction the classifier
+                # draws (rca/heal.py)
+                self.health.record_clean_pass()
             if state["failures"] and self.tier not in ("rules_fallback",
                                                        "breaker_open"):
                 self.tier = "steady"
@@ -463,6 +495,13 @@ class ShieldedScorer:
             raise exc
         stage = getattr(exc, "stage", "")
         suspect = stage not in _RETRIABLE_STAGES
+        shard = getattr(exc, "shard", None)
+        if shard is not None:
+            # graft-heal: the fault is localized to ONE mesh position —
+            # feed the per-shard classifier (N consecutive failures on
+            # one position open its breaker = persistently failed shard,
+            # which flips the ladder from replay to mesh_heal)
+            self.health.record_failure(int(shard))
         if stage in ("dispatch", "execute", "pack", ""):
             # dispatch-class (or unattributed device-path) failure feeds
             # the circuit breaker; crossing the consecutive-failure
@@ -564,8 +603,19 @@ class ShieldedScorer:
                 self._try_recover()
             return True
         if step == "journal_replay":
+            if self._heal_ready() is not None:
+                # a mesh position is CLASSIFIED persistently failed:
+                # replaying bit-identical state onto the same dying
+                # device is futile — fall through to the mesh_heal rung
+                return False
             self._transition(step)
             return self._try_recover()
+        if step == "mesh_heal":
+            pos = self._heal_ready()
+            if pos is None:
+                return False
+            self._transition(step)
+            return self._try_heal(pos)
         if step == "full_rebuild":
             self._transition(step)
             self.scorer._rebuild()
@@ -590,6 +640,190 @@ class ShieldedScorer:
         except (RuntimeError, OSError, KeyError, pickle.PickleError) as exc:
             log.error("recovery_failed", error=str(exc))
             return False
+
+    # -- graft-heal: live resharding + re-expansion ------------------------
+
+    def _heal_enabled(self) -> bool:
+        return bool(getattr(self.settings, "mesh_heal_enabled", True))
+
+    def _heal_ready(self) -> "int | None":
+        """Mesh position the classifier has declared persistently failed
+        — or None (nothing classified / heal disabled / not sharded, in
+        which case the existing replay/rebuild rungs apply unchanged)."""
+        if not self._heal_enabled() or self.scorer._graph_size() <= 1:
+            return None
+        return self.health.failed_position()
+
+    def _try_heal(self, pos: int) -> bool:
+        """The mesh_heal rung body: a heal failure (no viable survivor
+        layout, a placement error) reports False so the ladder escalates
+        to the full rebuild instead of wedging — escalation IS the
+        handling."""
+        try:
+            self.mesh_heal(positions=(int(pos),))
+            return True
+        except (RuntimeError, OSError, ValueError) as exc:
+            log.error("mesh_heal_failed", error=str(exc))
+            obs_scope.FLIGHT_RECORDER.note_event(
+                "mesh_heal_failed", error=str(exc)[:200])
+            return False
+
+    def mesh_heal(self, positions: tuple[int, ...] = (),
+                  exclude_devices: tuple[int, ...] = ()) -> dict:
+        """Live D→D' resharding around failed hardware: WAL-journal the
+        heal FIRST (crash-consistency — same order as delta batches and
+        params swaps), then re-place the resident state onto a survivor
+        mesh at the largest viable D' (rca/heal.plan_reshard) at a queue
+        generation boundary. ``positions`` are CURRENT mesh positions
+        (the classifier's verdicts — translated to global device indices
+        here, since positions shift with every reshard); callers that
+        already know the dead chip (benches, operators) pass
+        ``exclude_devices`` directly. Returns the heal plan."""
+        from . import heal as heal_mod
+        s = self.scorer
+        t0 = time.perf_counter()
+        with s.serve_lock:
+            d_old = s._graph_size()
+            mesh_devs = (list(s.mesh.devices.flat)
+                         if s.mesh is not None else [])
+            dead = set(int(i) for i in exclude_devices)
+            for pos in positions:
+                if 0 <= int(pos) < len(mesh_devs):
+                    dead.add(heal_mod.device_index(mesh_devs[int(pos)]))
+            excluded = tuple(sorted(set(self._mesh_excluded) | dead))
+            survivors = len(jax.devices()) - len(excluded)
+            d_new = heal_mod.plan_reshard(
+                s.snapshot.padded_nodes, d_old, survivors)
+            seq = int(s._synced_seq)
+            self._heal_gen += 1
+            self.journal.append(
+                (), seq, seq, kind="mesh_heal", force_sync=True,
+                shards=d_new, exclude=excluded, from_shards=d_old,
+                heal_gen=self._heal_gen)
+            mesh = heal_mod.survivor_mesh(d_new, excluded)
+            s.adopt_mesh(mesh)
+            self._mesh_excluded = excluded
+        for pos in positions:
+            if 0 <= int(pos) < len(mesh_devs):
+                self.health.exclude(
+                    int(pos), heal_mod.device_index(mesh_devs[int(pos)]))
+        self.heals += 1
+        self.last_heal_seconds = time.perf_counter() - t0
+        obs_metrics.MESH_HEALS.inc()
+        obs_metrics.MESH_SERVING_SHARDS.set(float(max(d_new, 1)))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "mesh_heal", from_shards=d_old, to_shards=d_new,
+            excluded=list(excluded), heal_gen=self._heal_gen)
+        # the on-disk snapshot still carries the OLD mesh shape: force a
+        # fresh one at the next generation boundary so recovery replays
+        # at most one heal record
+        self._ticks_since_snapshot = self.snapshot_every
+        log.warning("mesh_healed", from_shards=d_old, to_shards=d_new,
+                    excluded=excluded,
+                    seconds=round(self.last_heal_seconds, 4))
+        return {"from_shards": d_old, "shards": d_new,
+                "excluded": excluded, "heal_gen": self._heal_gen}
+
+    def _maybe_reexpand(self) -> None:
+        """Half-open probe gate: once every excluded device's breaker has
+        cooled down, grow D' back to the home mesh — the probe IS the
+        next guarded tick. A clean pass closes the probing breakers; one
+        more shard-localized failure re-opens and re-heals immediately."""
+        if (self._mesh_excluded and self._heal_enabled()
+                and self.health.can_reexpand()):
+            self.reexpand()
+
+    def reexpand(self) -> "dict | None":
+        """Grow D'→D at a queue generation boundary when the device
+        returns (graft-evolve hot-swap discipline: in-flight ticks
+        complete on the old mesh, superseded). WAL-journaled exactly like
+        the heal, so crash-mid-expansion recovers to a consistent shard
+        count. Returns the plan, or None when nothing is excluded."""
+        from . import heal as heal_mod
+        s = self.scorer
+        with s.serve_lock:
+            if not self._mesh_excluded:
+                return None
+            d_old = s._graph_size()
+            d_new = self._mesh_home
+            seq = int(s._synced_seq)
+            self._heal_gen += 1
+            self.journal.append(
+                (), seq, seq, kind="mesh_heal", force_sync=True,
+                shards=d_new, exclude=(), from_shards=d_old,
+                heal_gen=self._heal_gen, reexpand=True)
+            mesh = heal_mod.survivor_mesh(d_new, ())
+            s.adopt_mesh(mesh)
+            excluded, self._mesh_excluded = self._mesh_excluded, ()
+            mesh_devs = list(mesh.devices.flat) if mesh is not None else []
+        dev_to_pos = {heal_mod.device_index(d): p
+                      for p, d in enumerate(mesh_devs)}
+        self.health.note_reexpanded(dev_to_pos)
+        self.reexpansions += 1
+        obs_metrics.MESH_REEXPANSIONS.inc()
+        obs_metrics.MESH_SERVING_SHARDS.set(float(max(d_new, 1)))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "mesh_reexpand", from_shards=d_old, to_shards=d_new,
+            probed=list(excluded), heal_gen=self._heal_gen)
+        self._ticks_since_snapshot = self.snapshot_every
+        log.warning("mesh_reexpanded", from_shards=d_old, to_shards=d_new,
+                    probed=excluded)
+        return {"from_shards": d_old, "shards": d_new,
+                "probed": excluded, "heal_gen": self._heal_gen}
+
+    def _attest_and_repair(self) -> tuple[int, ...]:
+        """Per-shard state attestation at a snapshot generation boundary
+        (rca/heal.attest_fold vs the host-truth oracle): SILENT per-shard
+        corruption — the class the whole-state nonfinite backstop can
+        only catch after it serves a wrong verdict — is detected here,
+        localized to its shard, and repaired by re-uploading exactly the
+        mismatched blocks from the host-truth mirrors (never a
+        whole-state rebuild). Caller holds ``serve_lock``. Returns the
+        mismatched shard positions; each one also feeds the shard-loss
+        classifier (recurring silent corruption on one position is a
+        failing device)."""
+        if not getattr(self.settings, "mesh_attest", True):
+            return ()
+        from . import heal as heal_mod
+        s = self.scorer
+        if len(s._pending_feat):
+            # staged-but-undrained deltas (a coalesced tick) mean the
+            # host mirrors are LEGITIMATELY ahead of the device: a fold
+            # now would false-flag healthy shards and feed the failure
+            # classifier — attest at the next drained boundary instead
+            return ()
+        pairs = s._attest_arrays()
+        g = max(s._graph_size(), 1) if s._graph_sharded(
+            s.snapshot.padded_nodes, s.snapshot.padded_incidents) else 1
+        dev = np.asarray(jax.device_get(heal_mod.attest_fold(
+            *[getattr(s, attr) for attr, _host in pairs], shards=g)))
+        host = heal_mod.attest_host([h for _a, h in pairs], g)
+        mismatch = dev != host                     # [arrays, shards]
+        bad = tuple(int(k) for k in np.flatnonzero(mismatch.any(axis=0)))
+        if not bad:
+            return ()
+        for ai, (attr, truth) in enumerate(pairs):
+            arr = getattr(s, attr)
+            rows = arr.shape[0] // g
+            for k in bad:
+                if not mismatch[ai, k]:
+                    continue
+                block = np.ascontiguousarray(
+                    np.asarray(truth)[k * rows:(k + 1) * rows])
+                arr = arr.at[k * rows:(k + 1) * rows].set(
+                    jnp.asarray(block, dtype=arr.dtype))
+            setattr(s, attr, arr)
+        s._apply_sharding()
+        self.attest_repairs += 1
+        for k in bad:
+            obs_metrics.MESH_ATTEST_MISMATCH.inc(shard=str(k))
+            self.health.record_failure(k)
+        obs_metrics.MESH_ATTEST_REPAIRS.inc()
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "attest_repair", shards=list(bad),
+            arrays=[a for a, _h in pairs])
+        log.warning("attest_repaired_shards", shards=bad)
+        return bad
 
     def _watchdog(self, elapsed_s: float) -> None:
         if not self.tick_timeout_s or elapsed_s <= self.tick_timeout_s:
@@ -656,6 +890,10 @@ class ShieldedScorer:
         s = self.scorer
         t0 = time.perf_counter()
         with s.serve_lock:
+            # graft-heal: attest BEFORE the capture — a silently
+            # corrupted shard block must be localized and repaired from
+            # host truth here, never persisted into the recovery anchor
+            self._attest_and_repair()
             arrays = s._resident_arrays()
             layout = tuple((tuple(int(d) for d in a.shape), str(a.dtype))
                            for a in arrays)
@@ -663,6 +901,7 @@ class ShieldedScorer:
             host = pickle.dumps(s.capture_host_state(),
                                 protocol=pickle.HIGHEST_PROTOCOL)
             store_seq = int(s._synced_seq)
+            mesh_shards = s._graph_size()
         self.last_capture_seconds = time.perf_counter() - t0
         state = {"epoch": self._epoch, "store_seq": store_seq,
                  "klass": type(s).__name__, "layout": layout,
@@ -671,7 +910,14 @@ class ShieldedScorer:
                  # compaction uses it to drop only swap records the
                  # snapshot already reflects (the packed arrays carry the
                  # params values themselves)
-                 "params_gen": int(getattr(s, "params_generation", 0))}
+                 "params_gen": int(getattr(s, "params_generation", 0)),
+                 # graft-heal: the mesh shape the packed arrays were
+                 # captured AT — recovery re-points the mesh before
+                 # adopting, and compaction drops only heal records this
+                 # snapshot already reflects (the params_swap discipline)
+                 "mesh_shards": int(mesh_shards),
+                 "mesh_exclude": tuple(self._mesh_excluded),
+                 "heal_gen": int(self._heal_gen)}
         self.snapshots += 1
         self._ticks_since_snapshot = 0
         obs_metrics.SHIELD_SNAPSHOTS.inc()
@@ -687,7 +933,8 @@ class ShieldedScorer:
         try:
             nbytes = self.journal.write_snapshot(state)
             self.journal.compact(state["store_seq"],
-                                 through_params_gen=state["params_gen"])
+                                 through_params_gen=state["params_gen"],
+                                 through_heal_gen=state.get("heal_gen"))
         except (OSError, RuntimeError) as exc:
             # a failed persist leaves the previous snapshot intact; the
             # next cadence (or recovery-time rebuild) covers the gap
@@ -726,6 +973,19 @@ class ShieldedScorer:
             return {"mode": "full_rebuild", "replayed": 0, "seconds": dt}
         replayed = 0
         with s.serve_lock:
+            # graft-heal: the packed arrays were captured at the
+            # snapshot's mesh shape — re-point the mesh BEFORE adopting
+            # so _apply_sharding places them at the layout they carry
+            # (a crash between a heal and its covering snapshot restores
+            # here, then replays the heal record below)
+            from . import heal as heal_mod
+            snap_shards = int(state.get("mesh_shards", s._graph_size()))
+            snap_excl = tuple(state.get("mesh_exclude", ()))
+            self._heal_gen = int(state.get("heal_gen", self._heal_gen))
+            if (snap_shards != s._graph_size()
+                    or snap_excl != self._mesh_excluded):
+                s.mesh = heal_mod.survivor_mesh(snap_shards, snap_excl)
+                self._mesh_excluded = snap_excl
             s.restore_host_state(pickle.loads(state["host"]))
             parts = _snapshot_unpack(jnp.asarray(state["flat"]),
                                      layout=state["layout"])
@@ -733,6 +993,23 @@ class ShieldedScorer:
             batches, torn = self.journal.read()
             rb0 = s.rebuilds
             for b in batches:
+                if b.kind == "mesh_heal":
+                    # a heal/re-expansion journaled after the snapshot:
+                    # re-apply it in file order so post-heal delta
+                    # batches replay onto the shard count that actually
+                    # served them — crash-mid-heal lands consistent
+                    gen = int(b.meta.get("heal_gen", 0))
+                    if gen <= self._heal_gen:
+                        continue
+                    excl = tuple(b.meta.get("exclude", ()))
+                    s.adopt_mesh(heal_mod.survivor_mesh(
+                        int(b.meta["shards"]), excl))
+                    self._mesh_excluded = excl
+                    self._heal_gen = gen
+                    obs_scope.FLIGHT_RECORDER.note_event(
+                        "mesh_heal_replayed", shards=int(b.meta["shards"]),
+                        heal_gen=gen)
+                    continue
                 if b.kind == "params_swap":
                     # a swap journaled after the snapshot: re-install its
                     # exact leaves so post-swap deltas replay onto the
@@ -792,6 +1069,12 @@ class ShieldedScorer:
             "torn_truncations": self.journal.torn_truncations,
             "breaker": self.breaker.stats(),
             "breaker_skips": self.breaker_skips,
+            "heals": self.heals,
+            "reexpansions": self.reexpansions,
+            "attest_repairs": self.attest_repairs,
+            "mesh_excluded": self._mesh_excluded,
+            "serving_shards": self.scorer._graph_size(),
+            "shard_health": self.health.stats(),
         }
 
     def close(self) -> None:

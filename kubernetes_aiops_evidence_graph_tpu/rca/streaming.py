@@ -1678,6 +1678,10 @@ class StreamingScorer:
         # already dead and the outputs may be poisoned — the shield's
         # recovery tiers are the only way back to the pre-fault state
         self._fault_point("execute")
+        # graft-heal: per-shard device faults on the graph-sharded state
+        # (a single mesh position's block dies, localized — the shield's
+        # shard-loss classifier distinguishes this from whole-device loss)
+        self._fault_point("shard_loss")
         self.dispatches += 1
         # graft-surge: every device pass scores EVERY live incident on
         # the resident state — the histogram makes cross-tenant batching
@@ -1794,6 +1798,130 @@ class StreamingScorer:
         pi = self.snapshot.padded_incidents
         self._chain0 = jnp.zeros((pi,), jnp.float32)
         self._apply_sharding()
+
+    # -- graft-heal seams (live resharding) --------------------------------
+
+    def adopt_mesh(self, mesh) -> None:
+        """graft-heal: re-point the resident serving state at a DIFFERENT
+        (1 x D') serving mesh — live resharding after a classified shard
+        loss (D' < D onto the survivors) or re-expansion when the device
+        returns (D' -> D). Caller holds ``serve_lock`` (the shield's
+        ``mesh_heal``); the flip happens at a queue generation boundary:
+        every in-flight tick is superseded (it completes on the OLD mesh,
+        its result is dropped unfetched — the graft-evolve hot-swap
+        discipline) and the device state is RE-DERIVED from the
+        host-truth mirrors (``snapshot.features`` is bit-identical to the
+        resident buffer by the mirror contract; the evidence tables
+        re-materialize from the authoritative host lists), so a corrupted
+        dead-shard block never survives into the healed placement. Host
+        bookkeeping — row maps, free lists, pair maps — is untouched:
+        the healed scorer is the same scorer on a different mesh, which
+        is what makes post-heal rules verdicts bit-identical to a fresh
+        D' build. Pending host deltas are already reflected in the host
+        mirrors (mutations write host-first), so they are dropped rather
+        than redundantly re-scattered."""
+        self._supersede_inflight()
+        self.mesh = mesh
+        pi = self.snapshot.padded_incidents
+        self._features_dev = jnp.asarray(
+            np.ascontiguousarray(self.snapshot.features))
+        ev_idx, ev_cnt, ev_pair = self._materialize_rows(range(pi))
+        self._ev_idx_dev = jnp.asarray(ev_idx)
+        self._ev_cnt_dev = jnp.asarray(ev_cnt)
+        self._pair_dev = jnp.asarray(ev_pair)
+        self._chain0 = jnp.zeros((pi,), jnp.float32)
+        self._pending_feat.clear()
+        self._dirty_rows.clear()
+        self._apply_sharding()
+        self._rearm_warm_growth()
+
+    def warm_mesh(self, mesh, delta_sizes: tuple[int, ...] = (64,),
+                  row_sizes: tuple[int, ...] = (4,)) -> None:
+        """graft-heal: pre-compile the serving tick at the CURRENT shapes
+        on a DIFFERENT (survivor/home) mesh, so the first post-heal tick
+        pays upload, not an XLA compile — the warm() discipline applied
+        to the heal target. Read-only with respect to serving: stand-in
+        zero states only, placed on the TARGET mesh (executables key on
+        input shardings)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        with self.serve_lock:
+            pn = self.snapshot.padded_nodes
+            pi = self.snapshot.padded_incidents
+            dim = self.snapshot.features.shape[1]
+            width, pw = self.width, self.pair_width
+        g = (mesh.shape["graph"]
+             if mesh is not None and "graph" in mesh.axis_names else 1)
+        if g > 1 and pn % g:
+            return
+        if g > 1:
+            # the heal forces a fresh snapshot at the next generation
+            # boundary: warm the attestation fold and the snapshot pack
+            # at the TARGET placement too, or the first post-heal
+            # boundary pays their compiles inside the recovery window
+            from jax.sharding import NamedSharding as _NS
+            from .heal import attest_fold
+            from .shield import _snapshot_pack
+            gsh = _NS(mesh, P("graph"))
+            r1 = _NS(mesh, P("dp"))
+            r2 = _NS(mesh, P("dp", None))
+            feats = jax.device_put(jnp.zeros((pn, dim), jnp.float32), gsh)
+            tables = (jax.device_put(
+                          jnp.zeros((pi, width), jnp.int32), r2),
+                      jax.device_put(jnp.zeros((pi,), jnp.int32), r1),
+                      jax.device_put(
+                          jnp.full((pi, width), pw, jnp.int32), r2))
+            attest_fold(feats, shards=g)
+            _snapshot_pack(feats, *tables)
+        for pk in delta_sizes:
+            for rk in row_sizes or (_ROW_BUCKETS[0],):
+                if self._warm_stop:
+                    return
+                if g > 1:
+                    from ..parallel.sharded_streaming import (
+                        sharded_rules_tick)
+                    tick = sharded_rules_tick(mesh, pn // g, pi, pw,
+                                              pk, rk, width)
+                    gsh = NamedSharding(mesh, P("graph"))
+                    r1 = NamedSharding(mesh, P("dp"))
+                    r2 = NamedSharding(mesh, P("dp", None))
+                    ints = _pack_ints_sharded(
+                        np.full((g, pk), pn // g, np.int32),
+                        np.full(rk, pi, np.int32), np.zeros(rk, np.int32),
+                        np.zeros((rk, width), np.int32),
+                        np.full((rk, width), pw, np.int32))
+                    tick(jax.device_put(
+                            jnp.zeros((pn, dim), jnp.float32), gsh),
+                         jnp.asarray(ints),
+                         jnp.asarray(np.zeros((g, pk, dim), np.float32)),
+                         jax.device_put(
+                            jnp.zeros((pi, width), jnp.int32), r2),
+                         jax.device_put(jnp.zeros((pi,), jnp.int32), r1),
+                         jax.device_put(
+                            jnp.full((pi, width), pw, jnp.int32), r2),
+                         jax.device_put(
+                            jnp.zeros((pi,), jnp.float32), r1))
+                else:
+                    ints = _pack_ints(
+                        np.full(pk, pn, np.int32),
+                        np.full(rk, pi, np.int32), np.zeros(rk, np.int32),
+                        np.zeros((rk, width), np.int32),
+                        np.full((rk, width), pw, np.int32))
+                    _tick(jnp.zeros((pn, dim), jnp.float32),
+                          jnp.asarray(ints),
+                          jnp.asarray(np.zeros((pk, dim), np.float32)),
+                          jnp.zeros((pi, width), jnp.int32),
+                          jnp.zeros((pi,), jnp.int32),
+                          jnp.full((pi, width), pw, jnp.int32),
+                          jnp.zeros((pi,), jnp.float32),
+                          padded_incidents=pi, pair_width=pw,
+                          pk=pk, rk=rk, width=width)
+
+    def _attest_arrays(self) -> list[tuple[str, np.ndarray]]:
+        """graft-heal: (device attr, host-truth mirror) pairs the
+        per-shard attestation fold covers — node-addressed resident
+        arrays whose host copies are bit-identical by the mirror
+        contract. Subclasses extend with their aux mirrors."""
+        return [("_features_dev", self.snapshot.features)]
 
     # -- pipelined executor (graft-pipeline) -------------------------------
     #

@@ -1,0 +1,493 @@
+"""graft-heal: elastic shard-loss survival for the resident serving mesh
+(rca/heal.py + the shield's mesh_heal rung; marker ``fault_injection``).
+
+Acceptance pins (ISSUE 15):
+
+* a persistently failed shard (N consecutive localized failures) at D=4
+  heals onto a survivor mesh at D'=3 with rules verdicts BIT-identical
+  to a fresh D'=3 build (and to the unfaulted D=4 run), the GNN tick
+  verdict-identical (the graft-fleet contract), and the ppermute census
+  of the healed live tick collapsed to exactly (LAYERS+1)·D';
+* a TRANSIENT shard fault (below the classification threshold) recovers
+  through the existing replay rungs and never resharding;
+* re-expansion D'→D after the half-open device probe is bit-identical
+  to never-failed D serving, and crash-mid-heal (including a heal that
+  reached the WAL but never applied) recovers to a consistent shard
+  count through the journal;
+* the per-shard attestation fold localizes an injected SILENT
+  single-shard corruption to exactly that shard and repairs it from the
+  host-truth mirrors — no whole-state rebuild;
+* the randomized chaos sweep (seed echoed; replay with
+  ``KAEG_CHAOS_SEED=<seed>``) holds parity with shard_loss in the pool.
+
+Bucket ladders divide by 12 so both D=4 and the D'=3 survivor layout
+actually shard (``pn % D == 0`` — the _graph_sharded contract the heal
+planner honors).
+"""
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import sync_topology
+from kubernetes_aiops_evidence_graph_tpu.observability import metrics as obs_metrics
+from kubernetes_aiops_evidence_graph_tpu.rca.faults import Fault, FaultInjector
+from kubernetes_aiops_evidence_graph_tpu.rca.shield import ShieldedScorer
+from kubernetes_aiops_evidence_graph_tpu.rca.streaming import StreamingScorer
+from kubernetes_aiops_evidence_graph_tpu.simulator import generate_cluster, inject
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, store_step,
+)
+from kubernetes_aiops_evidence_graph_tpu.collectors import (
+    collect_all, default_collectors,
+)
+
+pytestmark = pytest.mark.fault_injection
+
+# every rung divides by 12 = lcm(4, 3): the D=4 serving layout AND the
+# D'=3 survivor layout both satisfy pn % D == 0
+_BUCKETS = dict(node_bucket_sizes=(384, 1536),
+                edge_bucket_sizes=(2048, 8192),
+                incident_bucket_sizes=(12, 48))
+
+EVENTS, BATCH = 120, 20
+
+# a seeded persistent loss of mesh position 2 with repeats == the
+# classification threshold: failures 1..N-1 walk the transient rungs,
+# failure N opens the position's breaker and the ladder heals
+SHARD_LOSS = Fault("shard_loss", at=2, kind="shard_loss", repeats=3,
+                   shard=2)
+
+
+def _settings(**over):
+    over.setdefault("mesh_heal_cooldown_s", 60.0)   # no implicit reexpand
+    return load_settings(
+        serve_pipeline_depth=2, shield_snapshot_every_ticks=3,
+        shield_retry_backoff_s=0.001, mesh_shard_failure_threshold=3,
+        **_BUCKETS, **over)
+
+
+def _world(settings, seed=13, num_pods=120):
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    injected = []
+    for i, name in enumerate(("crashloop_deploy", "oom", "network")):
+        inc = inject(cluster, name, keys[i * 5 % len(keys)], rng)
+        injected.append(inc)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, settings), parallel=False))
+    return cluster, builder, injected
+
+
+def _run_churn(shards, faults=(), injector=None, scorer_factory=None,
+               settings=None, events=EVENTS, batch=BATCH,
+               sleep_between_batches=0.0):
+    settings = settings or _settings(serve_graph_shards=shards)
+    cluster, builder, injected = _world(settings)
+    if scorer_factory is None:
+        scorer = StreamingScorer(builder.store, settings,
+                                 now_s=cluster.now.timestamp())
+    else:
+        scorer = scorer_factory(builder, settings, cluster)
+    if injector is None and faults:
+        injector = FaultInjector(faults)
+    shield = ShieldedScorer(scorer, settings,
+                            directory=tempfile.mkdtemp(prefix="kaeg-heal-"),
+                            injector=injector)
+    shield.recover_or_snapshot()
+    stream = list(churn_events(
+        cluster, events, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    for s in range(0, len(stream), batch):
+        for ev in stream[s:s + batch]:
+            store_step(cluster, builder.store, ev)
+        shield.tick()
+        if sleep_between_batches:
+            time.sleep(sleep_between_batches)
+    out = shield.rescore()
+    return out, shield, injected
+
+
+_VERDICT_KEYS = ("top_rule_index", "any_match", "top_confidence",
+                 "top_score", "scores", "conditions", "matched")
+
+
+def _verdicts(out, injected):
+    alias = {f"incident:{inc.id}": f"inj-{i}"
+             for i, inc in enumerate(injected)}
+    keys = [k for k in _VERDICT_KEYS if k in out] or ["probs"]
+    if "probs" in out:
+        keys = ["probs", "top_rule_index", "any_match", "top_confidence"]
+    res = {}
+    for row, iid in enumerate(out["incident_ids"]):
+        vals = tuple(np.asarray(out[k])[row].tobytes() for k in keys)
+        res[alias.get(iid, iid)] = vals
+    return res
+
+
+def _assert_bit_parity(faulted, baseline, injected_f, injected_b):
+    mine = _verdicts(faulted, injected_f)
+    ref = _verdicts(baseline, injected_b)
+    assert mine.keys() == ref.keys()
+    for iid in ref:
+        assert mine[iid] == ref[iid], f"verdict diverged for {iid}"
+
+
+@pytest.fixture(scope="module")
+def baselines():
+    """Unfaulted replays: the never-failed D=4 run and the fresh D'=3
+    build every heal outcome is judged against. The two must already be
+    bit-identical (the graft-fleet cross-D contract — the premise the
+    heal parity claims compose on)."""
+    out = {}
+    for shards in (3, 4):
+        res, shield, injected = _run_churn(shards)
+        assert shield.heals == 0 and shield.recoveries == 0
+        assert shield.scorer._graph_sharded(
+            shield.scorer.snapshot.padded_nodes,
+            shield.scorer.snapshot.padded_incidents), \
+            f"premise: D={shards} did not shard"
+        out[shards] = (res, injected)
+    _assert_bit_parity(out[4][0], out[3][0], out[4][1], out[3][1])
+    return out
+
+
+# -- planning units ---------------------------------------------------------
+
+def test_plan_reshard_and_survivor_mesh():
+    from kubernetes_aiops_evidence_graph_tpu.rca.heal import (
+        plan_reshard, survivor_mesh)
+    # largest D' < D that survivors carry AND pn divides over
+    assert plan_reshard(384, 4, survivors=7) == 3
+    assert plan_reshard(384, 4, survivors=2) == 2
+    assert plan_reshard(1024, 4, survivors=7) == 2   # 1024 % 3 != 0
+    assert plan_reshard(1021, 4, survivors=7) == 1   # prime: no layout
+    assert plan_reshard(384, 2, survivors=7) == 1    # only D'=1 below 2
+    m = survivor_mesh(3, exclude=(2,))
+    devs = jax.devices()
+    assert list(m.devices.flat) == [devs[0], devs[1], devs[3]]
+    assert m.shape == {"dp": 1, "graph": 3}
+    assert survivor_mesh(1, ()) is None
+    assert survivor_mesh(8, exclude=(0,)) is None    # pool too small
+
+
+def test_attest_fold_matches_host_oracle_and_flags_corruption():
+    import jax.numpy as jnp
+    from kubernetes_aiops_evidence_graph_tpu.rca.heal import (
+        attest_fold, attest_host)
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(48, 8)).astype(np.float32)
+    kind = rng.integers(0, 5, 48).astype(np.int32)
+    dev = np.asarray(attest_fold(jnp.asarray(feats), jnp.asarray(kind),
+                                 shards=4))
+    host = attest_host([feats, kind], 4)
+    np.testing.assert_array_equal(dev, host)
+    # corrupt ONE shard block of one array: exactly that column flags
+    bad = feats.copy()
+    bad[12:24] = np.nan                                # shard 1's block
+    dev2 = np.asarray(attest_fold(jnp.asarray(bad), jnp.asarray(kind),
+                                  shards=4))
+    mism = (dev2 != host).any(axis=0)
+    np.testing.assert_array_equal(mism, [False, True, False, False])
+
+
+# -- the heal ladder --------------------------------------------------------
+
+def test_persistent_shard_loss_heals_to_survivor_mesh(baselines):
+    """THE acceptance pin: D=4 shard loss → D'=3 resharded serving,
+    bit-identical to the fresh D'=3 build AND the never-failed D=4 run;
+    the healed state actually carries the D'=3 graph sharding."""
+    from jax.sharding import PartitionSpec
+    h0 = obs_metrics.MESH_HEALS.value()
+    out, shield, injected = _run_churn(4, faults=[SHARD_LOSS])
+    assert shield.injector.fired, "fault never fired"
+    assert shield.heals >= 1 and "mesh_heal" in shield.tier_log, \
+        shield.stats()
+    assert obs_metrics.MESH_HEALS.value() > h0
+    s = shield.scorer
+    assert s._graph_size() == 3
+    assert shield._mesh_excluded == (2,)
+    assert s._features_dev.sharding.spec == PartitionSpec("graph"), \
+        "healed state lost the graph sharding"
+    for d in (3, 4):
+        base, injected_b = baselines[d]
+        _assert_bit_parity(out, base, injected, injected_b)
+
+
+def test_transient_shard_fault_recovers_without_resharding(baselines):
+    """One localized fault (below the N-consecutive threshold) is
+    transient by classification: the replay rungs cure it, the mesh
+    stays at D=4, and parity holds — the transient/persistent
+    distinction is the whole point of the classifier."""
+    out, shield, injected = _run_churn(
+        4, faults=[Fault("shard_loss", at=2, kind="shard_loss", shard=1)])
+    assert shield.injector.fired
+    assert shield.heals == 0
+    assert "mesh_heal" not in shield.tier_log
+    assert shield.scorer._graph_size() == 4
+    assert shield.recoveries >= 1           # replay rung did the curing
+    base, injected_b = baselines[4]
+    _assert_bit_parity(out, base, injected, injected_b)
+
+
+def test_reexpansion_bit_identical_to_never_failed(baselines):
+    """Re-expansion D'→D at a generation boundary once the dead device's
+    breaker admits its half-open probe: the final mesh is back at D=4
+    with zero exclusions and verdicts bit-identical to never-failed D=4
+    serving."""
+    r0 = obs_metrics.MESH_REEXPANSIONS.value()
+    out, shield, injected = _run_churn(
+        4, faults=[SHARD_LOSS],
+        settings=_settings(serve_graph_shards=4, mesh_heal_cooldown_s=0.01),
+        sleep_between_batches=0.02)
+    assert shield.heals >= 1 and shield.reexpansions >= 1, shield.stats()
+    assert obs_metrics.MESH_REEXPANSIONS.value() > r0
+    assert shield.scorer._graph_size() == 4
+    assert shield._mesh_excluded == ()
+    base, injected_b = baselines[4]
+    _assert_bit_parity(out, base, injected, injected_b)
+
+
+def test_crash_mid_heal_recovers_consistent_shard_count(baselines):
+    """Crash-consistency of the heal itself: (a) a crash AFTER the heal
+    applied recovers straight to D'=3 (the snapshot records its mesh
+    shape); (b) a heal that reached the WAL but never applied — the
+    worst crash point — replays during recovery, landing on the journaled
+    shard count with parity intact."""
+    out, shield, injected = _run_churn(4, faults=[SHARD_LOSS])
+    assert shield.heals >= 1
+    base, injected_b = baselines[4]
+
+    # (a) post-heal crash: recover restores the D'=3 placement
+    FaultInjector._corrupt_resident(shield.scorer)
+    res = shield.recover()
+    assert res["mode"] == "journal_replay"
+    assert shield.scorer._graph_size() == 3
+    assert shield._mesh_excluded == (2,)
+    _assert_bit_parity(shield.rescore(), base, injected, injected_b)
+
+    # (b) WAL-only heal (crash between append and apply): replay applies
+    # it — D''=2 around devices {2, 3} — and the state stays coherent
+    s = shield.scorer
+    shield.journal.append(
+        (), int(s._synced_seq), int(s._synced_seq), kind="mesh_heal",
+        force_sync=True, shards=2, exclude=(2, 3), from_shards=3,
+        heal_gen=shield._heal_gen + 1)
+    FaultInjector._corrupt_resident(s)
+    shield.recover()
+    assert shield.scorer._graph_size() == 2
+    assert shield._mesh_excluded == (2, 3)
+    _assert_bit_parity(shield.rescore(), base, injected, injected_b)
+
+
+def test_attestation_localizes_silent_shard_corruption(baselines):
+    """A SILENT single-shard corruption (nothing raises; the rules fold
+    absorbs NaN through threshold compares) is detected by the per-shard
+    attestation fold at the next snapshot boundary, localized to exactly
+    the corrupted shard, and repaired from the host-truth mirrors — no
+    whole-state rebuild, no recovery, parity intact. Seeded: replay with
+    KAEG_CHAOS_SEED=<seed>."""
+    seed = int(os.environ.get("KAEG_CHAOS_SEED", "20260805"))
+    print(f"\nattest chaos seed={seed}")
+    rng = np.random.default_rng(seed)
+    shard = int(rng.integers(0, 4))
+    visit = int(rng.integers(1, 3))
+    m0 = {k: obs_metrics.MESH_ATTEST_MISMATCH.value(shard=str(k))
+          for k in range(4)}
+    out, shield, injected = _run_churn(
+        4, faults=[Fault("shard_loss", at=visit,
+                         kind="shard_corrupt_silent", shard=shard)])
+    assert shield.injector.fired, "silent corruption never fired"
+    assert shield.attest_repairs >= 1, "attestation never repaired"
+    assert obs_metrics.MESH_ATTEST_MISMATCH.value(
+        shard=str(shard)) > m0[shard], "mismatch not localized"
+    for k in range(4):
+        if k != shard:
+            assert obs_metrics.MESH_ATTEST_MISMATCH.value(
+                shard=str(k)) == m0[k], f"shard {k} falsely implicated"
+    assert shield.scorer.rebuilds == 0, "repair escalated to a rebuild"
+    assert shield.heals == 0
+    base, injected_b = baselines[4]
+    _assert_bit_parity(out, base, injected, injected_b)
+
+
+def test_randomized_shard_loss_chaos_sweep(baselines):
+    """Chaos: a seeded random schedule mixing shard_loss (raising AND
+    silent) with the classic tick stages at D=4 — parity must hold
+    wherever the schedule lands. Seed echoed for replay."""
+    seed = int(os.environ.get("KAEG_CHAOS_SEED", "20260805"))
+    print(f"\nshard-loss chaos seed={seed}")
+    n_ticks = EVENTS // BATCH + 1
+    injector = FaultInjector.seeded(
+        seed, ticks=n_ticks, rate=0.25,
+        stages=("staging", "dispatch", "shard_loss", "journal_append"),
+        shards=4)
+    out, shield, injected = _run_churn(4, injector=injector)
+    base, injected_b = baselines[4]
+    _assert_bit_parity(out, base, injected, injected_b)
+    for k in ("scores", "top_score"):
+        assert np.isfinite(np.asarray(out[k])).all()
+
+
+# -- the GNN scorer ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gnn_params():
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+    return gnn.init_params(jax.random.PRNGKey(0))
+
+
+def _gnn_factory(params):
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+        GnnStreamingScorer)
+
+    def make(builder, settings, cluster):
+        return GnnStreamingScorer(builder.store, settings, params=params,
+                                  now_s=cluster.now.timestamp())
+    return make
+
+
+def test_gnn_heal_verdict_parity_and_census(gnn_params):
+    """The GNN tick heals too: the edge mirror RE-BUCKETS its dst-owner
+    regions at D'=3 (verdict-identical to a fresh D'=3 build — the
+    graft-fleet churn contract), and the healed live tick's collective
+    census collapses to exactly (LAYERS+1)·D' ppermutes with zero
+    all-gathers — the CostSpec contract re-checked at the new mesh
+    shape."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_jaxpr)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import LAYERS
+    base, bshield, binj = _run_churn(
+        3, scorer_factory=_gnn_factory(gnn_params), events=60)
+    assert bshield.scorer._mirror_sharded
+    out, shield, injected = _run_churn(
+        4, faults=[SHARD_LOSS],
+        scorer_factory=_gnn_factory(gnn_params), events=60)
+    assert shield.heals >= 1, shield.stats()
+    s = shield.scorer
+    assert s._graph_size() == 3 and s._mirror_sharded
+
+    pf, pb = _verdicts(out, injected), _verdicts(base, binj)
+    assert pf.keys() == pb.keys()
+    alias_f = {f"incident:{inc.id}": f"inj-{i}"
+               for i, inc in enumerate(injected)}
+    rows_f = {alias_f.get(i, i): r
+              for r, i in enumerate(out["incident_ids"])}
+    alias_b = {f"incident:{inc.id}": f"inj-{i}" for i, inc in enumerate(binj)}
+    rows_b = {alias_b.get(i, i): r
+              for r, i in enumerate(base["incident_ids"])}
+    for key in pb:
+        np.testing.assert_allclose(
+            np.asarray(out["probs"])[rows_f[key]],
+            np.asarray(base["probs"])[rows_b[key]],
+            rtol=2e-4, atol=1e-6, err_msg=f"probs diverged for {key}")
+        assert (out["top_rule_index"][rows_f[key]]
+                == base["top_rule_index"][rows_b[key]])
+
+    # the census pin at D': (LAYERS+1)·3 ppermutes, nothing else
+    tick = s._sharded_tick_fn(64, 64)
+    g, pi = s._graph_size(), s.snapshot.padded_incidents
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (s._params, s._features_dev, s._kind_dev, s._nmask_dev,
+         s._esrc_dev, s._edst_dev, s._erel_dev, s._emask_dev))
+    ints = jax.ShapeDtypeStruct((g, 3 * 64 + 5 * 64 + 2 * pi), np.int32)
+    cost = cost_jaxpr("healed.gnn_tick", jax.make_jaxpr(tick)(*sds, ints))
+    assert cost.collectives["ppermute"]["count"] == (LAYERS + 1) * 3
+    assert "all_gather" not in cost.collectives
+    assert "psum" not in cost.collectives
+
+
+# -- satellites -------------------------------------------------------------
+
+def test_heal_attest_entrypoint_registered_zero_collective():
+    """heal.attest_fold is a registered audit entrypoint: zero dot
+    FLOPs, zero collectives (the D=1 CostSpec) — attestation may never
+    grow compute or go distributed implicitly."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_entrypoint)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        ENTRYPOINTS)
+    by_name = {e.name: e for e in ENTRYPOINTS}
+    entry = by_name["heal.attest_fold"]
+    cost = cost_entrypoint(entry)
+    assert cost.dot_flops == 0
+    assert not cost.collectives
+    assert cost.collective_bytes == 0
+
+
+def test_flight_dump_retention_prunes_old_dumps(tmp_path):
+    """FlightRecorder retention: repeated shield transitions must not
+    grow the dump dir without bound — the newest ``flight_dump_keep``
+    dumps survive, older ones are pruned and counted."""
+    from kubernetes_aiops_evidence_graph_tpu.observability.scope import (
+        FlightRecorder)
+    p0 = obs_metrics.SCOPE_FLIGHT_DUMPS_PRUNED.value()
+    fr = FlightRecorder(capacity=8, retention=3)
+    fr.note_event("x")
+    paths = [fr.dump(f"tier:test{i}", str(tmp_path)) for i in range(7)]
+    assert all(p is not None for p in paths)
+    left = sorted(f for f in os.listdir(tmp_path) if f.endswith(".json"))
+    assert len(left) == 3
+    # the NEWEST three survive
+    assert [os.path.basename(p) for p in paths[-3:]] == left
+    assert fr.pruned == 4
+    assert obs_metrics.SCOPE_FLIGHT_DUMPS_PRUNED.value() - p0 == 4
+    # retention off: nothing pruned
+    fr2 = FlightRecorder(capacity=8, retention=0)
+    for i in range(5):
+        fr2.dump(f"tier:off{i}", str(tmp_path / "off"))
+    assert len(os.listdir(tmp_path / "off")) == 5
+
+
+def test_serving_mesh_strict_raises_clear_error(monkeypatch):
+    """satellite: serve_graph_shards beyond the (post-fallback) device
+    pool must produce a CLEAR error on the strict path — never a silent
+    misshaped mesh — and ensure_host_devices is idempotent (the forced
+    flag is appended at most once)."""
+    from kubernetes_aiops_evidence_graph_tpu.parallel import mesh as mesh_mod
+    with pytest.raises(mesh_mod.MeshUnavailable) as ei:
+        mesh_mod.serving_mesh(16, strict=True)
+    msg = str(ei.value)
+    assert "16" in msg and "8" in msg     # requested vs available counts
+    # non-strict keeps the logged single-device fallback (None)
+    assert mesh_mod.serving_mesh(16) is None
+    # idempotence of the pre-init flag append: the forced count lands in
+    # XLA_FLAGS exactly once no matter how many times it is requested
+    monkeypatch.setattr(mesh_mod, "_backend_initialized", lambda: False)
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert mesh_mod.ensure_host_devices(4)
+    flags_once = os.environ["XLA_FLAGS"]
+    assert mesh_mod.ensure_host_devices(4)
+    assert os.environ["XLA_FLAGS"] == flags_once
+    assert flags_once.count(mesh_mod._FORCE_FLAG) == 1
+
+
+def test_bench_mesh_heal_record_emits_hermetically_on_cpu():
+    """The serving_mesh_heal record emits on CPU with parity gated inside
+    the bench (it raises on divergence) and reshard MTTR strictly below
+    the full-rebuild MTTR."""
+    import bench
+    # 700 pods: big enough that the rebuild's O(N) tensorize clears the
+    # reshard's fixed costs by a wide margin (the tight-margin 120-pod
+    # shape is load-flaky on a one-core box; the CI graft-heal job gates
+    # the same record at 1000 pods)
+    rec = bench.bench_serving_mesh_heal(
+        num_pods=700, num_incidents=18, events=90, batch_size=30,
+        verbose=False)
+    assert rec["metric"] == "serving_mesh_heal"
+    assert rec["parity"] == "bit_identical"
+    assert rec["from_shards"] == 4 and rec["to_shards"] == 3
+    assert rec["reshard_strictly_cheaper"] is True
+    assert rec["mttr_reshard_ms"] < rec["mttr_rebuild_ms"]
+    assert rec["halo_collectives_post_heal"] == {"psum": 1}
+    assert rec["platform"] == "cpu"

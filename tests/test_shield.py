@@ -450,12 +450,17 @@ def test_gnn_device_loss_recovers_bit_identical(gnn_params):
 def test_gnn_silent_corruption_caught_by_verdict_finite_guard(gnn_params):
     """The nastiest fault class: the resident state dies but nothing
     raises. The verdict-boundary finite guard is the backstop — NaN probs
-    must quarantine + recover, never serve."""
+    must quarantine + recover, never serve. graft-heal's attestation is
+    the new FIRST line against this class (it repairs at the snapshot
+    boundary before the verdict ever fetches — tests/test_heal.py), so
+    this run disables it to prove the backstop alone still holds."""
     base, bshield, binj = _run_churn(
         2, scorer_factory=_gnn_factory(gnn_params), events=60)
     out, shield, injected = _run_churn(
         2, faults=[Fault("execute", at=1, kind="corrupt_silent")],
-        scorer_factory=_gnn_factory(gnn_params), events=60)
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, mesh_attest=False))
+    assert shield.attest_repairs == 0
     assert shield.quarantined_batches >= 1 or shield.recoveries >= 1
     assert np.isfinite(np.asarray(out["probs"])).all()
     _assert_bit_parity(out, base, injected, binj)
@@ -482,6 +487,53 @@ def test_gnn_fused_tick_device_loss_recovers_bit_identical(gnn_params):
     composed, cshield, cinj = _run_churn(
         2, scorer_factory=_gnn_factory(gnn_params), events=60)
     _assert_bit_parity(base, composed, binj, cinj)
+
+
+def test_sharded_fused_tick_device_loss_recovers_bit_identical(gnn_params):
+    """graft-heal satellite: the fault parity matrix gains the
+    fused×SHARDED rows — gnn_fused_tick on the graph-sharded mirror
+    promotes the shard-local kernel to Pallas (halo ring stays XLA), and
+    device-loss recovery must reproduce the unfaulted fused-sharded
+    replay bit-identically, which must itself bit-match the stock
+    sharded tick (lowering never changes verdicts, under faults
+    included)."""
+    cfg = dict(serve_graph_shards=2, gnn_fused_tick=True)
+    base, bshield, binj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert bshield.recoveries == 0
+    assert bshield.scorer._mirror_sharded, \
+        "premise: GNN mirror not graph-sharded"
+    assert bshield.scorer._use_fused, "premise: fused tier not configured"
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss")],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert shield.recoveries >= 1
+    _assert_bit_parity(out, base, injected, binj)
+    assert np.isfinite(np.asarray(out["probs"])).all()
+    stock, sshield, sinj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, serve_graph_shards=2))
+    _assert_bit_parity(base, stock, binj, sinj)
+
+
+def test_sharded_fused_kernel_fallback_rung_under_shard_faults(gnn_params):
+    """The fused→composed→XLA rung is proven under SHARD faults too: a
+    persistent device fault on the fused×sharded configuration strips
+    ``_use_fused`` (the sharded tick's shard-local kernel drops from
+    Pallas back to XLA) while serving continues finite."""
+    t0 = obs_metrics.SHIELD_TIER_TRANSITIONS.value(tier="kernel_fallback")
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss", repeats=3)],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, serve_graph_shards=2, gnn_fused_tick=True))
+    assert shield.scorer._use_fused is False, \
+        "kernel_fallback did not strip the fused tier on the sharded mirror"
+    assert obs_metrics.SHIELD_TIER_TRANSITIONS.value(
+        tier="kernel_fallback") > t0
+    assert len(out["incident_ids"]) > 0
+    assert np.isfinite(np.asarray(out["probs"])).all()
 
 
 def test_gnn_fused_kernel_fallback_degrades_to_composed(gnn_params):
