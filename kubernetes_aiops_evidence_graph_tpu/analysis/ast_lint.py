@@ -109,10 +109,25 @@ JIT_DECLARATIONS: dict[tuple[str, str], tuple[tuple[str, ...], tuple[int, ...]]]
         (2, 3, 4, 5, 6, 7)),
     # graft-fuse: the fused streaming tick — same donation contract as
     # _gnn_tick (the resident mirror flows through the one Pallas
-    # kernel's aliased outputs, never reallocates)
+    # kernel's aliased outputs, never reallocates); graft-tide adds the
+    # bf16 compute static
     ("rca/gnn_streaming.py", "_gnn_fused_tick"): (
-        ("pk", "ek", "pi", "rel_offsets"),
+        ("pk", "ek", "pi", "rel_offsets", "compute_dtype"),
         (2, 3, 4, 5, 6, 7)),
+    # graft-tide: the beyond-VMEM DMA streaming tick — the donated set
+    # grows by the two persistent [N, H] activation ping-pong buffers
+    # (positions 9/10), rebound from the outputs every tick; features
+    # (position 1) stays read-only on the f32 path
+    ("rca/gnn_streaming.py", "_gnn_dma_tick"): (
+        ("pk", "ek", "pi", "rel_offsets", "node_block", "compute_dtype"),
+        (2, 3, 4, 5, 6, 7, 9, 10)),
+    # graft-tide quantized tiers: the HBM-resident bf16/int8 feature
+    # table (position 1) is part of the resident mirror — donated and
+    # rebound through the kernel's aliased output like the edge arrays
+    ("rca/gnn_streaming.py", "_gnn_dma_tick_q"): (
+        ("pk", "ek", "pi", "rel_offsets", "node_block", "compute_dtype",
+         "feat_quant"),
+        (1, 2, 3, 4, 5, 6, 7, 9, 10)),
     # graft-shield snapshot kernels: pack/unpack the resident state into
     # ONE int32 transfer (no donation — the resident buffers must survive
     # the snapshot; registered jaxpr entrypoints with zero-collective cost)

@@ -21,7 +21,20 @@ entrypoint's closed jaxpr and roll up
   nothing to HBM bytes. (The previous model charged in-kernel value
   flows as HBM, which both overcharged per-row VMEM accesses ~3× and
   gave fusion zero credit for the inter-kernel HBM round-trips it
-  eliminates — the fused tick's whole reason to exist.) In-kernel
+  eliminates — the fused tick's whole reason to exist.) graft-tide
+  refines the call-site charge for beyond-VMEM kernels: operands and
+  results whose block mapping places them in ANY memory space stay
+  HBM-resident — the runtime does NOT stream them through VMEM, the
+  kernel moves exactly the slices it touches with explicit async
+  copies — so ANY-space positions are excluded from the call-site
+  bytes and every in-kernel ``dma_start`` is charged its precise
+  payload instead (indexer shape × itemsize, read when the HBM side is
+  the source, write when it is the destination, loop-weighted like any
+  other eqn; ``dma_wait`` moves nothing and costs nothing). Without
+  this split a 500k-pod DMA tick would be billed the full resident
+  mirror per call — orders of magnitude above the tile traffic it
+  actually streams — and the A/B record against
+  ``dma_tick_traffic_floor`` could never hold. In-kernel
   materialization stays policed by the per-intermediate byte budget and
   the peak-liveness number below, which DO keep counting kernel values;
 * **peak live-intermediate bytes** via per-scope liveness (def →
@@ -182,6 +195,76 @@ def _is_ref(aval) -> bool:
     return hasattr(aval, "inner_aval")
 
 
+def _space(aval) -> str:
+    """Normalized memory-space tag of an aval ('' when unspaced)."""
+    return str(getattr(aval, "memory_space", None) or "").lower()
+
+
+def _pallas_any_positions(eqn) -> tuple[set, set]:
+    """(input idxs, output idxs) of ANY-memory-space pallas_call operands.
+
+    ``block_mappings`` lists inputs then outputs; invars additionally
+    lead with ``num_index_operands`` scalar-prefetch args that have no
+    block mapping (and ARE real call-site transfers, so never skipped).
+    """
+    gm = eqn.params.get("grid_mapping")
+    bms = list(getattr(gm, "block_mappings", ()) or ())
+    nidx = int(getattr(gm, "num_index_operands", 0) or 0)
+    nin = int(getattr(gm, "num_inputs", len(bms)) or 0)
+    ins: set = set()
+    outs: set = set()
+    for j, bm in enumerate(bms):
+        aval = getattr(bm, "transformed_block_aval", None)
+        if "any" not in _space(aval):
+            continue
+        if j < nin:
+            ins.add(nidx + j)
+        else:
+            outs.add(j - nin)
+    return ins, outs
+
+
+def _dma_payload_bytes(ref, transforms) -> int:
+    """Bytes one async copy moves on `ref`'s side: the last NDIndexer's
+    indexer shape (the ref aval's own shape when untransformed) ×
+    itemsize."""
+    aval = getattr(ref, "aval", None)
+    if aval is None:
+        return 0
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    for t in tuple(transforms or ()):
+        get_shape = getattr(t, "get_indexer_shape", None)
+        if get_shape is not None:
+            shape = tuple(get_shape())
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(getattr(getattr(aval, "dtype", None), "itemsize", 4))
+
+
+def _dma_start_traffic(eqn) -> tuple[int, int]:
+    """(hbm_read, hbm_write) bytes for one in-kernel ``dma_start``.
+
+    The flat invars unflatten via ``params['tree']`` to
+    ``(src_ref, src_transforms, dst_ref, dst_transforms, sem, ...)``.
+    An ANY-space ref lives in HBM: copying FROM it is an HBM read,
+    copying TO it is an HBM write; VMEM↔VMEM copies cost nothing here.
+    """
+    import jax
+    try:
+        flat = jax.tree_util.tree_unflatten(
+            eqn.params["tree"], list(eqn.invars))
+        src, src_tf, dst, dst_tf = flat[0], flat[1], flat[2], flat[3]
+    except Exception:  # graft-audit: allow[broad-except] unknown dma layouts must degrade to uncharged, not crash the cost pass
+        return 0, 0
+    reads = writes = 0
+    if "any" in _space(getattr(src, "aval", None)):
+        reads = _dma_payload_bytes(src, src_tf)
+    if "any" in _space(getattr(dst, "aval", None)):
+        writes = _dma_payload_bytes(dst, dst_tf)
+    return reads, writes
+
+
 def _eqn_sub_jaxprs(eqn):
     for pv in eqn.params.values():
         yield from _iter_sub_jaxprs(pv)
@@ -244,10 +327,17 @@ def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
                     steps *= int(d)
                 inner_mult = mult * max(steps, 1)
                 inner_kernel = True
-                call_reads = sum(_aval_bytes(v.aval) for v in eqn.invars
-                                 if _is_var(v) and not _is_ref(v.aval))
-                call_writes = sum(_aval_bytes(v.aval) for v in eqn.outvars
-                                  if not _is_ref(v.aval))
+                # graft-tide: ANY-space positions are HBM-resident — the
+                # kernel's explicit dma_starts (priced below) move their
+                # traffic, not the call-site stream
+                any_in, any_out = _pallas_any_positions(eqn)
+                call_reads = sum(
+                    _aval_bytes(v.aval) for i, v in enumerate(eqn.invars)
+                    if _is_var(v) and not _is_ref(v.aval)
+                    and i not in any_in)
+                call_writes = sum(
+                    _aval_bytes(v.aval) for k, v in enumerate(eqn.outvars)
+                    if not _is_ref(v.aval) and k not in any_out)
                 cost.hbm_read_bytes += call_reads * mult
                 cost.hbm_write_bytes += call_writes * mult
             subs = list(_eqn_sub_jaxprs(eqn))
@@ -259,6 +349,10 @@ def cost_jaxpr(name: str, closed_jaxpr) -> EntryCost:
             flops, dot = _eqn_flops(eqn)
             cost.flops += flops * mult
             cost.dot_flops += dot * mult
+            if in_kernel and prim == "dma_start":
+                dma_r, dma_w = _dma_start_traffic(eqn)
+                cost.hbm_read_bytes += dma_r * mult
+                cost.hbm_write_bytes += dma_w * mult
             if not in_kernel:
                 reads = sum(_aval_bytes(v.aval) for v in eqn.invars
                             if _is_var(v) and not _is_ref(v.aval))

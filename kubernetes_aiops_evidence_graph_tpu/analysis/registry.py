@@ -66,6 +66,16 @@ PALLAS_TILE_BUDGET = 2 * MIB
 FUSED_TICK_BUDGET = 4 * MIB
 PALLAS_VJP_BUDGET = 6 * MIB
 
+# graft-tide: the beyond-VMEM DMA tick streams node blocks and edge
+# tiles through a double-buffered VMEM window — at its canonical shapes
+# (pn = N_NODES = 16384, node_block = 2048) the largest legitimate
+# in-kernel value is one [node_block, H] f32 window product (512 KiB)
+# plus tile-scale edge math, so 8 MiB comfortably admits the windowed
+# math and rejects any [N, H]-resident (4 MiB × co-live tables) or
+# [E, H] materialization that would mean the kernel stopped streaming.
+DMA_NODE_BLOCK = 2048
+DMA_TICK_BUDGET = 8 * MIB
+
 # bucketed forward paths may not contain a set-scatter at all — the only
 # scatters are the per-slice 1-D dst segment-adds
 NO_SET_SCATTER = CALLBACK_PRIMS | frozenset({"scatter"})
@@ -349,14 +359,16 @@ def _gnn_tick_coalesced_build():
     return _gnn_tick_build(pk=_DELTA_BUCKETS[-1], ek=_DELTA_BUCKETS[-1])
 
 
-def _gnn_fused_tick_build():
+def _gnn_fused_tick_build(compute_dtype: str | None = None):
     """graft-fuse: the fused streaming tick — ONE pallas_call from the
     packed delta scatter through the relation-bucketed message pass to
     the logits/probs reduction, at the canonical GNN-tick shapes. The
     [N, H] activations live in VMEM scratch for the whole tick, so the
     modeled HBM bytes/tick must land STRICTLY below the composed
     streaming.gnn_tick.bucketed path's — the ratchet pins the lower
-    floor once recorded."""
+    floor once recorded. ``compute_dtype="bfloat16"`` traces the
+    graft-tide bf16-operand variant (f32 accumulation pinned by
+    ``bf16_accum_f32``)."""
     np = _np()
     from ..graph.schema import DIM
     from ..rca.gnn_streaming import _gnn_fused_tick
@@ -365,12 +377,51 @@ def _gnn_fused_tick_build():
     pe = int(offs[-1])
     pk = ek = 64
     ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
-    fn = partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs)
+    fn = partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                 compute_dtype=compute_dtype)
     args = (_params(), np.zeros((pn, DIM), np.float32),
             np.zeros(pn, np.int32), np.ones(pn, np.float32),
             np.zeros(pe, np.int32), np.zeros(pe, np.int32),
             np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
     return fn, args
+
+
+def _gnn_dma_tick_build(feat_quant: str = ""):
+    """graft-tide: the beyond-VMEM streaming tick — edge mirror, node
+    features, and the persistent [N, H] hidden state stay HBM-resident
+    (ANY memory space); the kernel streams EDGE_TILE/node-block windows
+    through double-buffered VMEM via explicit async copies. Traced at
+    pn = N_NODES (16384, 4× the resident canonical — a shape whose
+    resident working set the fused tick's own VMEM guard rejects) so
+    the cost model prices the DMA tile traffic, not a resident stream.
+    ``feat_quant`` picks the quantized node-feature table tier
+    ("bfloat16" | "int8" — int8 carries its per-column scale and the
+    delta rows arrive pre-quantized against the frozen scale)."""
+    np = _np()
+    from ..graph.schema import DIM
+    from ..rca.gnn_streaming import _gnn_dma_tick, _gnn_dma_tick_q
+    offs = _rel_offsets()
+    pn, pi = N_NODES, 32
+    pe = int(offs[-1])
+    pk = ek = 64
+    ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
+    h = np.zeros((pn, HIDDEN), np.float32)
+    mirror = (np.zeros(pn, np.int32), np.ones(pn, np.float32),
+              np.zeros(pe, np.int32), np.zeros(pe, np.int32),
+              np.full(pe, -1, np.int32), np.zeros(pe, np.float32), ints)
+    if not feat_quant:
+        fn = partial(_gnn_dma_tick, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                     node_block=DMA_NODE_BLOCK, compute_dtype=None)
+        return fn, (_params(), np.zeros((pn, DIM), np.float32), *mirror,
+                    h, h.copy())
+    import jax.numpy as jnp
+    qdt = jnp.int8 if feat_quant == "int8" else jnp.bfloat16
+    scale = (np.ones(DIM, np.float32) if feat_quant == "int8" else None)
+    fn = partial(_gnn_dma_tick_q, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                 node_block=DMA_NODE_BLOCK, compute_dtype=None,
+                 feat_quant=feat_quant)
+    return fn, (_params(), jnp.zeros((pn, DIM), qdt), *mirror,
+                h, h.copy(), jnp.zeros((pk, DIM), qdt), scale)
 
 
 def _pallas_gms_vjp_build():
@@ -745,6 +796,46 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "rejects [E, H]/[N, R, H] materializations); modeled HBM "
               "bytes/tick ratcheted STRICTLY below the composed tick's; "
               "explicit zero-collective CostSpec",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.gnn_tick.fused.bf16",
+        partial(_gnn_fused_tick_build, "bfloat16"),
+        InvariantSpec(max_intermediate_bytes=FUSED_TICK_BUDGET,
+                      bf16_accum_f32=True),
+        notes="graft-tide: fused tick with bf16 matmul operands — every "
+              "dot must still accumulate into f32 "
+              "(preferred_element_type), same VMEM-resident budget as "
+              "the f32 fused tick; zero-collective",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.gnn_tick.dma", _gnn_dma_tick_build,
+        InvariantSpec(max_intermediate_bytes=DMA_TICK_BUDGET),
+        notes="graft-tide: beyond-VMEM tick at pn=N_NODES — edge "
+              "mirror, features, and hidden state HBM-resident (ANY "
+              "space), streamed through a double-buffered VMEM window "
+              "by explicit async copies; the call-site stream model "
+              "prices the dma_start tile traffic (bench pins it within "
+              "1.25x of dma_tick_traffic_floor), fold order "
+              "bit-identical to the resident fused tick; "
+              "zero-collective",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.gnn_tick.dma.bf16",
+        partial(_gnn_dma_tick_build, "bfloat16"),
+        InvariantSpec(max_intermediate_bytes=DMA_TICK_BUDGET),
+        notes="graft-tide: DMA tick over a bfloat16 node-feature table "
+              "— halves the streamed feature bytes; features upcast to "
+              "f32 at the VMEM window, all accumulation f32; "
+              "zero-collective",
+        cost=COST_DEFAULT),
+    Entrypoint(
+        "streaming.gnn_tick.dma.int8",
+        partial(_gnn_dma_tick_build, "int8"),
+        InvariantSpec(max_intermediate_bytes=DMA_TICK_BUDGET),
+        notes="graft-tide: DMA tick over an int8 node-feature table "
+              "with per-column f32 scales (quarter feature bytes); "
+              "delta rows arrive pre-quantized against the frozen "
+              "scale, dequant + accumulate in f32; zero-collective",
         cost=COST_DEFAULT),
     Entrypoint(
         "ops.pallas_gms.vjp", _pallas_gms_vjp_build,

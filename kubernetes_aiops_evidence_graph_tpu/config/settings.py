@@ -155,6 +155,25 @@ class Settings:
     # assembly stays in XLA. The shield's kernel-fallback rung degrades
     # fused → composed → XLA under repeated device faults.
     gnn_fused_tick: bool = False
+    # graft-tide: the beyond-VMEM DMA streaming tick (ops/pallas_segment
+    # .py::pallas_fused_gnn_tick_dma) — features, edge mirror and [N, H]
+    # activations stay HBM-resident and stream through double-buffered
+    # VMEM windows. Auto-selected by the dispatcher (when enabled) once
+    # the resident tick's closed-form VMEM demand exceeds
+    # vmem_budget_bytes, or whenever a quantized feature tier is on.
+    # f32 path bit-identical to the composed oracle; serving-only.
+    gnn_tick_dma: bool = False
+    # soft VMEM budget the dispatcher compares fused_tick_vmem_bytes
+    # against when picking resident vs DMA tier (the hard placement
+    # ceiling is ops.pallas_segment._VMEM_HARD_LIMIT)
+    vmem_budget_bytes: int = 8 * 2 ** 20
+    # node rows per DMA staging block in the embed/update streams
+    # (power of two; clamped to the node bucket)
+    gnn_dma_node_block: int = 2048
+    # quantized node-feature table for the DMA tick: "" = f32,
+    # "bfloat16" = bf16 table, "int8" = per-column-scale symmetric int8
+    # (quantize_features). Tolerance-gated, forces the DMA tier.
+    gnn_feature_quant: str = ""
     llm_provider: str = "none"                     # none|gemini|openai|ollama
     llm_api_key: str = ""
     llm_model: str = ""
@@ -361,8 +380,15 @@ class Settings:
     learn_pallas_grads: bool = False
     mesh_dp: int = 1                               # data-parallel axis (incidents)
     mesh_graph: int = 1                            # graph-parallel axis (node shards)
-    node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536)
-    edge_bucket_sizes: tuple = (1024, 4096, 16384, 65536, 262144)
+    # graft-tide stretched the topology ladders to 500k-pod configs: the
+    # 262144/524288 node rungs and the 1M/4M edge rungs are DMA-tier
+    # territory (the resident fused tick refuses them — see
+    # ops.pallas_segment.fused_tick_vmem_bytes). Existing rungs are
+    # untouched so every previously-chosen static shape stays identical.
+    node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536,
+                                262144, 524288)
+    edge_bucket_sizes: tuple = (1024, 4096, 16384, 65536, 262144,
+                                1048576, 4194304)
     incident_bucket_sizes: tuple = (8, 32, 128, 512)
     # NOTE: there is deliberately no pallas flag — the fused rules kernel
     # measured at parity with the XLA path at config 3 (both ~0.2 ms/pass

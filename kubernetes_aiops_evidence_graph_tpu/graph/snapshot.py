@@ -40,7 +40,14 @@ from .store import EvidenceGraphStore, _Node
 # 8192-multiples keep slice bases tile-aligned. Shared by build_snapshot,
 # parallel/partition.py and the streaming edge mirror
 # (rca/gnn_streaming.py).
-REL_SLICE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192)
+# graft-tide stretched the ladder with named 8192-multiple rungs
+# (16384/24576/32768) for 500k-pod edge profiles. The rungs are exactly
+# the capacities the old beyond-top rule produced, and the step stays
+# anchored at _REL_SLICE_STEP above the ladder, so EVERY count rounds to
+# the same capacity as before the stretch — no static offset tuple, jit
+# cache key or cost baseline shifts.
+REL_SLICE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                     16384, 24576, 32768)
 _REL_SLICE_STEP = 8192
 
 
@@ -56,7 +63,10 @@ def rel_slice_offsets(counts, slack: float = 0.0,
     reserves room (the streaming mirror does, so first-edge churn of a
     new relation doesn't force an immediate re-mirror)."""
     offs = [0]
-    step = max(int(buckets[-1]), _REL_SLICE_STEP)
+    # anchored at _REL_SLICE_STEP (NOT buckets[-1]): the graft-tide rung
+    # stretch must not coarsen beyond-ladder rounding
+    step = max(int(buckets[-1]), _REL_SLICE_STEP) \
+        if buckets is not REL_SLICE_BUCKETS else _REL_SLICE_STEP
     for c in counts:
         need = max(int(np.ceil(int(c) * (1.0 + slack))), min_cap)
         if need <= 0:

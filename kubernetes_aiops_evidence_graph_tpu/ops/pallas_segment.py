@@ -75,6 +75,37 @@ graft-fuse extends this module in two directions:
   and per-edge accumulation replay the identical fold). Its
   ``custom_vjp`` rematerializes the composed forward over the
   differentiable Pallas gms above, so the fused tier is trainable too.
+
+graft-tide adds the beyond-VMEM exits the fused tick deferred:
+
+* **The DMA streaming tick** (``pallas_fused_gnn_tick_dma``, behind
+  ``settings.gnn_tick_dma``): the same tick for graphs whose mirrors +
+  activations outgrow VMEM. The node-feature table, the relation-
+  bucketed edge mirror and BOTH ``[N, H]`` activation buffers stay
+  HBM-resident (``memory_space=pltpu.ANY``); the kernel streams
+  EDGE_TILE-aligned ``(src, dst, mask)`` blocks through a
+  double-buffered VMEM window — ``pltpu.make_async_copy`` prefetch of
+  tile ``t+1`` overlapping compute of tile ``t`` — gathers source rows
+  and applies the per-destination accumulate through row-granular DMAs
+  against the HBM accumulator, in the SAME edge order as the resident
+  kernel, so the f32 path stays BIT-identical to the composed oracle.
+  Node-granular phases (embed, per-layer update) move ``node_block``-row
+  blocks through VMEM staging. Only small per-node vectors (kind, nmask,
+  degree) and the per-tile windows are VMEM-resident: the VMEM floor is
+  ~12 B/node + O(node_block·H), which carries 500k+ pods where the
+  resident tick's ``fused_tick_vmem_bytes`` demand exceeds
+  ``_VMEM_HARD_LIMIT``. Serving-only: no ``custom_vjp`` (training at
+  beyond-VMEM scale would need cross-block checkpointing — the resident
+  tiers stay the trainable ones).
+
+* **bf16 compute + quantized node-feature tiers**: both fused ticks
+  accept ``compute_dtype="bfloat16"`` (bf16 matmul operands, f32
+  accumulation via ``preferred_element_type`` — parity-gated against
+  the f32 tick like the bf16 gms kernel), and the DMA tick additionally
+  accepts a bf16 or per-column-scale int8 node-feature table
+  (``quantize_features``) that dequantizes block-by-block during the
+  embed stream — f32 accumulate, tolerance-suite parity, and 2–4x less
+  HBM feature traffic per tick.
 """
 from __future__ import annotations
 
@@ -370,7 +401,8 @@ _gms_vjp.defvjp(_gms_vjp_fwd, _gms_vjp_bwd)
 # -- fused streaming tick: delta-scatter -> message pass -> verdict --------
 
 def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
-                          pn: int, pe: int, num_tiles: int):
+                          pn: int, pe: int, num_tiles: int,
+                          compute_dtype=None):
     """Build the fused-tick kernel body for a static (layers, delta,
     incident, node, edge) shape set. One kernel invocation (no grid —
     the tile sweep is an in-kernel ``fori_loop``, so the cost model's
@@ -384,8 +416,23 @@ def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
     logits/probs in-kernel. The ``[N, H]`` activations live in VMEM
     scratch for the whole tick — they never exist as an HBM buffer
     between stages, which is the modeled bytes/tick floor this kernel
-    exists to lower."""
+    exists to lower.
+
+    ``compute_dtype`` (graft-tide, e.g. "bfloat16") casts MATMUL OPERANDS
+    only — every accumulation (agg, deg, residual adds, softmax) stays
+    f32 via ``preferred_element_type``, the same discipline the bf16 gms
+    kernel and the XLA forward follow, so the bf16 variant is
+    tolerance-gated, never a silent precision downgrade."""
     f32 = jnp.float32
+    cdt = None if compute_dtype is None else jnp.dtype(compute_dtype)
+
+    def mm(a, b):
+        # matmul-site cast: bf16 (or other compute dtype) operands, f32
+        # accumulation — identical to `a @ b` when cdt is None
+        if cdt is not None:
+            a = a.astype(cdt)
+            b = b.astype(cdt)
+        return jnp.dot(a, b, preferred_element_type=f32)
 
     def kernel(*refs):
         rel_ref, ints_ref, ew_ref, eb_ref, ke_ref, hw_ref, hb_ref = refs[:7]
@@ -440,7 +487,7 @@ def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
 
         # phase 2: embed, then the relation-bucketed rounds
         kind_v = kind_o[:]
-        h0 = jax.nn.relu(feat_ref[:] @ ew_ref[:] + eb_ref[:]
+        h0 = jax.nn.relu(mm(feat_ref[:], ew_ref[:]) + eb_ref[:]
                          + ke_ref[:][kind_v])
         h_ref[:] = h0 * nmask_o[:][:, None]
 
@@ -459,8 +506,7 @@ def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
                     return 0
 
                 jax.lax.fori_loop(0, EDGE_TILE, gather_row, 0)
-                msg_ref[:] = jnp.dot(gath_ref[:], wr_ref[rel_ref[t]],
-                                     preferred_element_type=f32)
+                msg_ref[:] = mm(gath_ref[:], wr_ref[rel_ref[t]])
 
                 def accum_row(e, _):
                     d = edst_o[base_e + e]
@@ -473,21 +519,21 @@ def _fused_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
             jax.lax.fori_loop(0, num_tiles, tile_body, 0)
             hv = h_ref[:]
             aggv = agg_ref[:] * inv_deg[:, None]
-            h_ref[:] = jax.nn.relu(hv @ ws_ref[:] + aggv + b_ref[:]) + hv
+            h_ref[:] = jax.nn.relu(mm(hv, ws_ref[:]) + aggv + b_ref[:]) + hv
 
         # phase 3: score reduction — readout, logits, masked softmax
         io = 3 * pk + 5 * ek
         inc_nodes = ints_ref[io:io + pi]
         inc_mask = ints_ref[io + pi:io + 2 * pi].astype(f32)
-        logits = h_ref[:][inc_nodes] @ hw_ref[:] + hb_ref[:]
+        logits = mm(h_ref[:][inc_nodes], hw_ref[:]) + hb_ref[:]
         logits_ref[:] = logits
         probs_ref[:] = jax.nn.softmax(logits, axis=-1) * inc_mask[:, None]
 
     return kernel
 
 
-def _fused_forward(pk, ek, pi, offs, interpret, params, features,
-                   kind, nmask, esrc, edst, erel, emask, ints):
+def _fused_forward(pk, ek, pi, offs, interpret, compute_dtype, params,
+                   features, kind, nmask, esrc, edst, erel, emask, ints):
     num_layers = len(params["layers"])
     pn = features.shape[0]
     pe = int(offs[-1])
@@ -514,7 +560,8 @@ def _fused_forward(pk, ek, pi, offs, interpret, params, features,
         jax.ShapeDtypeStruct((pi, classes), fdt),
     ]
     return pl.pallas_call(
-        _fused_kernel_factory(num_layers, pk, ek, pi, pn, pe, num_tiles),
+        _fused_kernel_factory(num_layers, pk, ek, pi, pn, pe, num_tiles,
+                              compute_dtype),
         out_shape=out_shape,
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(inputs),
         out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * len(out_shape),
@@ -532,22 +579,24 @@ def _fused_forward(pk, ek, pi, offs, interpret, params, features,
     )(*inputs)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
-def _fused_vjp(pk, ek, pi, offs, interpret, params, features,
-               kind, nmask, esrc, edst, erel, emask, ints):
-    return _fused_forward(pk, ek, pi, offs, interpret, params, features,
-                          kind, nmask, esrc, edst, erel, emask, ints)
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
+def _fused_vjp(pk, ek, pi, offs, interpret, compute_dtype, params,
+               features, kind, nmask, esrc, edst, erel, emask, ints):
+    return _fused_forward(pk, ek, pi, offs, interpret, compute_dtype,
+                          params, features, kind, nmask, esrc, edst,
+                          erel, emask, ints)
 
 
-def _fused_vjp_fwd(pk, ek, pi, offs, interpret, params, features,
-                   kind, nmask, esrc, edst, erel, emask, ints):
-    out = _fused_forward(pk, ek, pi, offs, interpret, params, features,
-                         kind, nmask, esrc, edst, erel, emask, ints)
+def _fused_vjp_fwd(pk, ek, pi, offs, interpret, compute_dtype, params,
+                   features, kind, nmask, esrc, edst, erel, emask, ints):
+    out = _fused_forward(pk, ek, pi, offs, interpret, compute_dtype,
+                         params, features, kind, nmask, esrc, edst, erel,
+                         emask, ints)
     return out, (params, features, kind, nmask, esrc, edst, erel, emask,
                  ints)
 
 
-def _fused_vjp_bwd(pk, ek, pi, offs, interpret, res, cts):
+def _fused_vjp_bwd(pk, ek, pi, offs, interpret, compute_dtype, res, cts):
     """Backward of the fused tick: rematerialize the composed
     scatter→forward→score path over the DIFFERENTIABLE Pallas gms (its
     own custom_vjp above supplies the transposed-layout backward
@@ -580,7 +629,8 @@ def _fused_vjp_bwd(pk, ek, pi, offs, interpret, res, cts):
         em2 = em.at[e_idx].set(e_mask, mode="drop")
         logits = gnn.forward(p, feats, kind2, nm2, esrc2, edst2, erel2,
                              em2, inc_nodes, rel_offsets=offs,
-                             slices_sorted=False, pallas=True)
+                             slices_sorted=False, pallas=True,
+                             compute_dtype=compute_dtype)
         probs = jax.nn.softmax(logits, axis=-1) * inc_mask[:, None]
         return nm2, em2, logits, probs
 
@@ -595,17 +645,24 @@ _fused_vjp.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
 
 def pallas_fused_gnn_tick(params, features, kind, nmask, esrc, edst,
                           erel, emask, ints, *, pk: int, ek: int, pi: int,
-                          rel_offsets, interpret: bool | None = None):
+                          rel_offsets, compute_dtype=None,
+                          interpret: bool | None = None):
     """The fused streaming tick (settings.gnn_fused_tick): one
     ``pallas_call`` applying the packed aux/edge delta to the resident
     mirrors, running the full relation-bucketed forward against the
     VMEM-resident activations, and reducing logits/probs in-kernel —
     the drop-in Pallas replacement for ``rca/gnn_streaming._gnn_tick``'s
     scatter→forward→score composition (same operand layout, same
-    returns, BIT-identical results; f32 only). Requires a non-empty
-    EDGE_TILE-aligned layout — the dispatcher keeps the composed tick
-    for everything else. Differentiable via ``custom_vjp`` (backward
-    rematerializes the composed path over the Pallas gms backward)."""
+    returns; BIT-identical results at f32, tolerance-gated at
+    ``compute_dtype="bfloat16"`` — bf16 matmul operands, f32
+    accumulation). Requires a non-empty EDGE_TILE-aligned layout — the
+    dispatcher keeps the composed tick for everything else — and a
+    graph whose resident working set fits ``_VMEM_HARD_LIMIT``: past
+    that the kernel cannot be placed at all, and this raises instead of
+    producing a trace the compiler must reject (the DMA tick below is
+    the tier for those shapes). Differentiable via ``custom_vjp``
+    (backward rematerializes the composed path over the Pallas gms
+    backward)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     offs = tuple(int(o) for o in rel_offsets or ())
@@ -614,6 +671,496 @@ def pallas_fused_gnn_tick(params, features, kind, nmask, esrc, edst,
             "pallas_fused_gnn_tick needs a non-empty EDGE_TILE-aligned "
             "relation-bucketed layout (dispatch falls back to the "
             "composed tick otherwise)")
+    demand = fused_tick_vmem_bytes(
+        pn=features.shape[0], pe=offs[-1], dim=features.shape[1],
+        hidden=params["embed_b"].shape[0],
+        classes=params["head_b"].shape[0],
+        num_kinds=params["kind_emb"].shape[0],
+        num_rels=params["layers"][0]["w_rel"].shape[0],
+        num_layers=len(params["layers"]), pk=pk, ek=ek, pi=pi)
+    if demand > _VMEM_HARD_LIMIT:
+        raise ValueError(
+            f"pallas_fused_gnn_tick: resident VMEM demand {demand} B "
+            f"exceeds the {_VMEM_HARD_LIMIT} B placement limit — this "
+            "shape is untraceable for the resident tier; use "
+            "pallas_fused_gnn_tick_dma (settings.gnn_tick_dma)")
+    cdt = None if compute_dtype is None else jnp.dtype(compute_dtype).name
     return _fused_vjp(int(pk), int(ek), int(pi), offs, bool(interpret),
-                      params, features, kind, nmask, esrc, edst, erel,
-                      emask, ints)
+                      cdt, params, features, kind, nmask, esrc, edst,
+                      erel, emask, ints)
+
+
+# -- graft-tide: beyond-VMEM DMA streaming tick + quantized tiers ----------
+
+# Hard placement ceiling for the RESIDENT fused tick: past this the
+# kernel's co-resident working set (mirrors + 2x [N, H] activations +
+# tile scratch) cannot sit in VMEM on any supported part, so the entry
+# point refuses the trace instead of emitting one that only fails at
+# compile time. The dispatcher's SOFT threshold (settings.
+# vmem_budget_bytes, default 8 MiB) flips to the DMA tier well before
+# this is hit; the hard limit is the honesty backstop the 500k-pod bench
+# pins (resident tier "skipped-as-untraceable").
+_VMEM_HARD_LIMIT = 16 * 2 ** 20
+
+
+def fused_tick_vmem_bytes(*, pn: int, pe: int, dim: int, hidden: int,
+                          classes: int, num_kinds: int, num_rels: int,
+                          num_layers: int, pk: int, ek: int,
+                          pi: int) -> int:
+    """Closed-form VMEM working set of the RESIDENT fused tick: every
+    operand, output and scratch buffer of ``_fused_forward`` is
+    VMEM-co-resident for the whole tick, so the demand is just the sum
+    of their byte sizes. Used by the dispatcher (vs ``settings.
+    vmem_budget_bytes``) to auto-select the DMA tier and by
+    ``pallas_fused_gnn_tick`` (vs ``_VMEM_HARD_LIMIT``) to refuse
+    untraceable shapes."""
+    f = 4  # every resident buffer is f32/int32
+    ints_len = 3 * pk + 5 * ek + 2 * pi
+    params_b = f * (dim * hidden + hidden + num_kinds * hidden
+                    + hidden * classes + classes
+                    + num_layers * (hidden * hidden
+                                    + num_rels * hidden * hidden
+                                    + hidden))
+    operands = (pn * dim * f            # feature table
+                + 2 * pn * f            # kind + nmask mirrors
+                + 4 * pe * f            # esrc/edst/erel/emask mirrors
+                + ints_len * f + params_b)
+    outputs = 2 * pi * classes * f      # logits + probs
+    scratch = (2 * pn * hidden * f      # activations + accumulator
+               + pn * f                 # degree
+               + 2 * EDGE_TILE * hidden * f)   # gather + message tiles
+    return operands + outputs + scratch
+
+
+def quantize_features(features, dtype: str = "int8"):
+    """Host-side node-feature quantization for the DMA tick's quantized
+    table tiers. ``int8``: per-column symmetric absmax scale
+    (``q = clip(round(x/scale), -127, 127)``, dequant ``q*scale`` — an
+    all-zero column gets scale 0 and dequantizes EXACTLY to zero, no
+    epsilon smuggled in). ``bfloat16``: plain downcast, scale is None.
+    Returns ``(table, scale)``."""
+    if dtype == "bfloat16":
+        return features.astype(jnp.bfloat16), None
+    if dtype != "int8":
+        raise ValueError(f"unsupported feature quant dtype: {dtype!r}")
+    scale = (jnp.max(jnp.abs(features), axis=0) / 127.0).astype(
+        jnp.float32)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(features / safe[None, :]), -127, 127)
+    q = jnp.where(scale[None, :] > 0, q, 0.0).astype(jnp.int8)
+    return q, scale
+
+
+def dma_tick_traffic_floor(*, pn: int, pe: int, dim: int, hidden: int,
+                           num_layers: int, pk: int, ek: int, pi: int,
+                           feat_bytes: int = 4,
+                           quant_delta_bytes: int = 0) -> int:
+    """Closed-form HBM tile-traffic floor of one DMA tick — the bytes
+    the streaming schedule MUST move (every block exactly once, no
+    re-fetch): the delta scatter, one pass over the feature table, and
+    per layer one zero + one edge sweep (windows, row gathers, RMW
+    accumulates) + one blockwise update. The cost model's measured
+    bytes/tick must land within 1.25x of this (bench
+    ``gnn_tick_dma_vs_resident``); the slack covers call-site VMEM
+    operand charges, not re-streaming."""
+    f = 4
+    bytes_ = 4 * ek * f                       # edge delta: 3x i32 + f32
+    if quant_delta_bytes:
+        bytes_ += pk * dim * quant_delta_bytes    # fq row scatter
+    bytes_ += pn * dim * feat_bytes           # embed: feature read
+    bytes_ += pn * hidden * f                 # embed: h0 write
+    per_layer = (pn * hidden * f              # zero the accumulator
+                 + 3 * pe * f                 # (src, dst, mask) windows
+                 + pe * hidden * f            # row gathers
+                 + 2 * pe * hidden * f        # RMW read + write
+                 + 3 * pn * hidden * f)       # update: hv + agg in, h out
+    bytes_ += num_layers * per_layer
+    bytes_ += pi * hidden * f                 # readout row gathers
+    return bytes_
+
+
+def _dma_kernel_factory(num_layers: int, pk: int, ek: int, pi: int,
+                        pn: int, pe: int, num_tiles: int, nb: int,
+                        dim: int, hidden: int, classes: int,
+                        feat_quant: str, compute_dtype):
+    """Build the DMA streaming tick kernel body. Same phase structure
+    and FOLD ORDER as ``_fused_kernel_factory`` — delta scatter, embed,
+    ``num_layers`` edge sweeps, readout — but every O(N)/O(E) table
+    (features, edge mirror, both activation buffers) lives in
+    ``memory_space=ANY`` (HBM) and moves through VMEM staging:
+
+    * the edge sweep double-buffers EDGE_TILE ``(src, dst, mask)``
+      windows — the prefetch of tile ``t+1`` is issued before the wait
+      on tile ``t``, so the copy overlaps compute (static slot parity:
+      two tiles per loop step, slots 0/1);
+    * source-row gathers and the per-destination accumulate are
+      row-granular DMAs against the HBM activations, applied in exact
+      edge order — the f32 path is bit-identical to the resident kernel;
+    * embed and the per-layer update stream ``nb``-row node blocks
+      (sequential copy in, compute, copy out);
+    * the activations ping-pong between the two donated HBM buffers:
+      layer ``li`` reads ``buf[li % 2]`` and accumulates+updates into
+      ``buf[(li+1) % 2]`` (zeroed blockwise first), so neither is ever
+      reallocated.
+
+    Only kind/nmask/degree ([N] vectors) and the staging windows are
+    VMEM-resident. ``feat_quant`` ("bfloat16"/"int8") dequantizes
+    feature blocks during the embed stream; int8 uses the per-column
+    scale operand. ``compute_dtype`` casts matmul operands only (f32
+    accumulation), exactly like the resident kernel."""
+    f32 = jnp.float32
+    cdt = None if compute_dtype is None else jnp.dtype(compute_dtype)
+    num_blocks = pn // nb
+    quant = feat_quant in ("bfloat16", "int8")
+    n_extra = {"": 0, "bfloat16": 1, "int8": 2}[feat_quant]
+    mb = 7 + 3 * num_layers + 1 + n_extra     # first mirror-seed index
+    n_out = 10 + (1 if quant else 0)
+
+    def mm(a, b):
+        if cdt is not None:
+            a = a.astype(cdt)
+            b = b.astype(cdt)
+        return jnp.dot(a, b, preferred_element_type=f32)
+
+    def kernel(*refs):
+        rel_ref, ints_ref, ew_ref, eb_ref, ke_ref, hw_ref, hb_ref = refs[:7]
+        layer_refs = refs[7:7 + 3 * num_layers]
+        feat_in = refs[7 + 3 * num_layers]
+        fq_rows_ref = refs[7 + 3 * num_layers + 1] if quant else None
+        scale_ref = refs[mb - 1] if feat_quant == "int8" else None
+        # refs[mb : mb+8] are aliased seeds (mirrors + h ping-pong) —
+        # never read; the aliased output refs below see the same bytes
+        out0 = mb + 8
+        (kind_o, nmask_o, esrc_o, edst_o, erel_o, emask_o,
+         logits_ref, probs_ref, ha_o, hb_o) = refs[out0:out0 + 10]
+        feat_o = refs[out0 + 10] if quant else None
+        (deg_ref, gath_ref, msg_ref, row_ref, ev_ref, fblk_ref,
+         hblk_ref, ablk_ref, srcw_ref, dstw_ref, maskw_ref, ro_ref,
+         sem_e, sem_blk, sem_row) = refs[out0 + n_out:]
+        feat_src = feat_o if quant else feat_in
+        bufs = (ha_o, hb_o)
+
+        def cp(src, dst, sem=sem_blk):
+            c = pltpu.make_async_copy(src, dst, sem)
+            c.start()
+            c.wait()
+
+        # phase 1: delta scatter. kind/nmask are VMEM-resident (direct
+        # stores, as in the resident kernel); the edge mirror is HBM, so
+        # each live slot lands via a 1-element DMA from the ints slab
+        # (emask stages through a f32 scalar — the slab is int32).
+        def scat_aux(j, _):
+            idx = ints_ref[j]
+
+            @pl.when(idx < pn)
+            def _():
+                kind_o[idx] = ints_ref[pk + j]
+                nmask_o[idx] = ints_ref[2 * pk + j].astype(f32)
+            if quant:
+                @pl.when(idx < pn)
+                def _():
+                    cp(fq_rows_ref.at[pl.ds(j, 1), :],
+                       feat_o.at[pl.ds(idx, 1), :], sem_row)
+            return 0
+
+        jax.lax.fori_loop(0, pk, scat_aux, 0)
+        o = 3 * pk
+
+        def scat_edge(j, _):
+            slot = ints_ref[o + j]
+
+            @pl.when(slot < pe)
+            def _():
+                cp(ints_ref.at[pl.ds(o + ek + j, 1)],
+                   esrc_o.at[pl.ds(slot, 1)], sem_row)
+                cp(ints_ref.at[pl.ds(o + 2 * ek + j, 1)],
+                   edst_o.at[pl.ds(slot, 1)], sem_row)
+                cp(ints_ref.at[pl.ds(o + 3 * ek + j, 1)],
+                   erel_o.at[pl.ds(slot, 1)], sem_row)
+                ev_ref[0] = ints_ref[o + 4 * ek + j].astype(f32)
+                cp(ev_ref.at[pl.ds(0, 1)],
+                   emask_o.at[pl.ds(slot, 1)], sem_row)
+            return 0
+
+        jax.lax.fori_loop(0, ek, scat_edge, 0)
+
+        # phase 2: embed — stream nb-row feature blocks through VMEM,
+        # dequantize in-block, write h0 blocks to buf[0]
+        def emb_block(i, _):
+            b0 = i * nb
+            cp(feat_src.at[pl.ds(b0, nb), :], fblk_ref.at[0])
+            x = fblk_ref[0]
+            if feat_quant == "int8":
+                x = x.astype(f32) * scale_ref[:][None, :]
+            elif x.dtype != f32:
+                x = x.astype(f32)
+            kv = kind_o[pl.ds(b0, nb)]
+            nmv = nmask_o[pl.ds(b0, nb)]
+            h0 = jax.nn.relu(mm(x, ew_ref[:]) + eb_ref[:] + ke_ref[:][kv])
+            hblk_ref[0] = h0 * nmv[:, None]
+            cp(hblk_ref.at[0], bufs[0].at[pl.ds(b0, nb), :])
+            return 0
+
+        jax.lax.fori_loop(0, num_blocks, emb_block, 0)
+        deg_ref[:] = jnp.zeros(deg_ref.shape, f32)
+
+        # per-layer: zero the HBM accumulator, edge sweep with
+        # double-buffered tile windows, blockwise update
+        for li in range(num_layers):
+            ws_ref = layer_refs[3 * li]
+            wr_ref = layer_refs[3 * li + 1]
+            b_ref = layer_refs[3 * li + 2]
+            cur = bufs[li % 2]
+            nxt = bufs[(li + 1) % 2]
+
+            ablk_ref[0] = jnp.zeros((nb, hidden), f32)
+
+            def zero_block(i, _, nxt=nxt):
+                cp(ablk_ref.at[0], nxt.at[pl.ds(i * nb, nb), :])
+                return 0
+
+            jax.lax.fori_loop(0, num_blocks, zero_block, 0)
+
+            def tile_start(t, s):
+                base = t * EDGE_TILE
+                for hbm, win in ((esrc_o, srcw_ref), (edst_o, dstw_ref),
+                                 (emask_o, maskw_ref)):
+                    pltpu.make_async_copy(
+                        hbm.at[pl.ds(base, EDGE_TILE)], win.at[s],
+                        sem_e.at[s]).start()
+
+            def tile_wait(t, s):
+                base = t * EDGE_TILE
+                for hbm, win in ((esrc_o, srcw_ref), (edst_o, dstw_ref),
+                                 (emask_o, maskw_ref)):
+                    pltpu.make_async_copy(
+                        hbm.at[pl.ds(base, EDGE_TILE)], win.at[s],
+                        sem_e.at[s]).wait()
+
+            def tile_compute(t, s, li=li, cur=cur, nxt=nxt, wr_ref=wr_ref):
+                rel = rel_ref[t]
+
+                def gather(e, _):
+                    srow = jnp.clip(srcw_ref[s, e], 0, pn - 1)
+                    cp(cur.at[pl.ds(srow, 1), :],
+                       gath_ref.at[pl.ds(e, 1), :], sem_row)
+                    gath_ref[e, :] = gath_ref[e, :] * maskw_ref[s, e]
+                    return 0
+
+                jax.lax.fori_loop(0, EDGE_TILE, gather, 0)
+                msg_ref[:] = mm(gath_ref[:], wr_ref[rel])
+                if li == 0:
+                    # degree folds into the first sweep (0/1 sums —
+                    # exact in any order, same as the resident kernel)
+                    def deg_body(e, _):
+                        d = jnp.clip(dstw_ref[s, e], 0, pn - 1)
+                        deg_ref[d] = deg_ref[d] + maskw_ref[s, e]
+                        return 0
+
+                    jax.lax.fori_loop(0, EDGE_TILE, deg_body, 0)
+
+                def accum(e, _):
+                    d = jnp.clip(dstw_ref[s, e], 0, pn - 1)
+                    cp(nxt.at[pl.ds(d, 1), :],
+                       row_ref.at[pl.ds(0, 1), :], sem_row)
+                    row_ref[0, :] = row_ref[0, :] + msg_ref[e, :]
+                    cp(row_ref.at[pl.ds(0, 1), :],
+                       nxt.at[pl.ds(d, 1), :], sem_row)
+                    return 0
+
+                jax.lax.fori_loop(0, EDGE_TILE, accum, 0)
+
+            # double-buffered sweep: two tiles per step, static slots —
+            # tile t+1's windows are in flight while tile t computes
+            tile_start(0, 0)
+
+            def pair_body(p, _):
+                t0 = 2 * p
+
+                @pl.when(t0 + 1 < num_tiles)
+                def _():
+                    tile_start(t0 + 1, 1)
+                tile_wait(t0, 0)
+                tile_compute(t0, 0)
+
+                @pl.when(t0 + 2 < num_tiles)
+                def _():
+                    tile_start(t0 + 2, 0)
+
+                @pl.when(t0 + 1 < num_tiles)
+                def _():
+                    tile_wait(t0 + 1, 1)
+                    tile_compute(t0 + 1, 1)
+                return 0
+
+            jax.lax.fori_loop(0, (num_tiles + 1) // 2, pair_body, 0)
+
+            if li == 0:
+                # degree is complete after the first sweep; invert once
+                # and reuse the buffer (deg_ref holds inv_deg from here)
+                degv = deg_ref[:]
+                deg_ref[:] = jnp.where(
+                    degv > 0, 1.0 / jnp.maximum(degv, 1.0), 0.0)
+
+            def upd_block(i, _, cur=cur, nxt=nxt, ws_ref=ws_ref,
+                          b_ref=b_ref):
+                b0 = i * nb
+                cp(cur.at[pl.ds(b0, nb), :], hblk_ref.at[0])
+                cp(nxt.at[pl.ds(b0, nb), :], ablk_ref.at[0])
+                hv = hblk_ref[0]
+                aggv = ablk_ref[0] * deg_ref[pl.ds(b0, nb)][:, None]
+                hn = jax.nn.relu(mm(hv, ws_ref[:]) + aggv
+                                 + b_ref[:]) + hv
+                hblk_ref[1] = hn
+                cp(hblk_ref.at[1], nxt.at[pl.ds(b0, nb), :])
+                return 0
+
+            jax.lax.fori_loop(0, num_blocks, upd_block, 0)
+
+        # phase 3: readout — pi row gathers from the final buffer
+        h_fin = bufs[num_layers % 2]
+        io = 3 * pk + 5 * ek
+
+        def ro_row(r, _):
+            idx = jnp.clip(ints_ref[io + r], 0, pn - 1)
+            cp(h_fin.at[pl.ds(idx, 1), :], ro_ref.at[pl.ds(r, 1), :],
+               sem_row)
+            return 0
+
+        jax.lax.fori_loop(0, pi, ro_row, 0)
+        inc_mask = ints_ref[io + pi:io + 2 * pi].astype(f32)
+        logits = mm(ro_ref[:], hw_ref[:]) + hb_ref[:]
+        logits_ref[:] = logits
+        probs_ref[:] = jax.nn.softmax(logits, axis=-1) * inc_mask[:, None]
+
+    return kernel
+
+
+def _dma_forward(pk, ek, pi, offs, nb, feat_quant, compute_dtype,
+                 interpret, params, features, kind, nmask, esrc, edst,
+                 erel, emask, ints, h_a, h_b, fq_rows, feat_scale):
+    num_layers = len(params["layers"])
+    pn = features.shape[0]
+    dim = features.shape[1]
+    pe = int(offs[-1])
+    num_tiles = pe // EDGE_TILE
+    hidden = params["embed_b"].shape[0]
+    classes = params["head_b"].shape[0]
+    quant = feat_quant in ("bfloat16", "int8")
+    rel_ids = jnp.asarray(_tile_rel_ids(offs))
+    layer_ops = []
+    for layer in params["layers"]:
+        layer_ops += [layer["w_self"], layer["w_rel"], layer["b"]]
+    inputs = [rel_ids, ints, params["embed_w"], params["embed_b"],
+              params["kind_emb"], params["head_w"], params["head_b"],
+              *layer_ops, features]
+    vmem, any_ = pl.BlockSpec(memory_space=pltpu.VMEM), \
+        pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [vmem] * (len(inputs) - 1) + [any_]   # features are HBM
+    if quant:
+        inputs.append(fq_rows)
+        in_specs.append(vmem)
+    if feat_quant == "int8":
+        inputs.append(feat_scale)
+        in_specs.append(vmem)
+    mirror_base = len(inputs)
+    inputs += [kind, nmask, esrc, edst, erel, emask, h_a, h_b]
+    in_specs += [vmem, vmem, any_, any_, any_, any_, any_, any_]
+    f32 = jnp.float32
+    out_shape = [
+        jax.ShapeDtypeStruct((pn,), kind.dtype),
+        jax.ShapeDtypeStruct((pn,), nmask.dtype),
+        jax.ShapeDtypeStruct((pe,), esrc.dtype),
+        jax.ShapeDtypeStruct((pe,), edst.dtype),
+        jax.ShapeDtypeStruct((pe,), erel.dtype),
+        jax.ShapeDtypeStruct((pe,), emask.dtype),
+        jax.ShapeDtypeStruct((pi, classes), f32),
+        jax.ShapeDtypeStruct((pi, classes), f32),
+        jax.ShapeDtypeStruct((pn, hidden), f32),
+        jax.ShapeDtypeStruct((pn, hidden), f32),
+    ]
+    out_specs = [vmem, vmem, any_, any_, any_, any_, vmem, vmem,
+                 any_, any_]
+    aliases = {mirror_base + i: i for i in range(6)}
+    aliases[mirror_base + 6] = 8
+    aliases[mirror_base + 7] = 9
+    if quant:
+        out_shape.append(
+            jax.ShapeDtypeStruct((pn, dim), features.dtype))
+        out_specs.append(any_)
+        aliases[7 + 3 * num_layers] = 10    # the quant table itself
+    return pl.pallas_call(
+        _dma_kernel_factory(num_layers, pk, ek, pi, pn, pe, num_tiles,
+                            nb, dim, hidden, classes, feat_quant,
+                            compute_dtype),
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((pn,), f32),                  # degree / inv_deg
+            pltpu.VMEM((EDGE_TILE, hidden), f32),    # gathered rows
+            pltpu.VMEM((EDGE_TILE, hidden), f32),    # message tile
+            pltpu.VMEM((1, hidden), f32),            # RMW row staging
+            pltpu.VMEM((1,), f32),                   # emask scatter stage
+            pltpu.VMEM((2, nb, dim), features.dtype),  # feature blocks
+            pltpu.VMEM((2, nb, hidden), f32),        # h block staging
+            pltpu.VMEM((1, nb, hidden), f32),        # agg/zero staging
+            pltpu.VMEM((2, EDGE_TILE), esrc.dtype),  # src windows
+            pltpu.VMEM((2, EDGE_TILE), edst.dtype),  # dst windows
+            pltpu.VMEM((2, EDGE_TILE), f32),         # mask windows
+            pltpu.VMEM((pi, hidden), f32),           # readout rows
+            pltpu.SemaphoreType.DMA((2,)),           # tile windows
+            pltpu.SemaphoreType.DMA,                 # block copies
+            pltpu.SemaphoreType.DMA,                 # row-granular DMAs
+        ],
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(*inputs)
+
+
+def pallas_fused_gnn_tick_dma(params, features, kind, nmask, esrc, edst,
+                              erel, emask, ints, h_a, h_b, *, pk: int,
+                              ek: int, pi: int, rel_offsets,
+                              node_block: int = 2048,
+                              compute_dtype=None, feat_quant: str = "",
+                              fq_rows=None, feat_scale=None,
+                              interpret: bool | None = None):
+    """The beyond-VMEM streaming tick (settings.gnn_tick_dma): the same
+    delta-scatter → message-pass → verdict tick as
+    ``pallas_fused_gnn_tick``, with features, edge mirror and
+    activations HBM-resident and streamed through double-buffered VMEM
+    windows (module docstring). ``h_a``/``h_b`` are the two donated
+    ``[N, hidden]`` f32 activation buffers — pure per-tick scratch the
+    caller keeps across ticks so they are never reallocated; they come
+    back as the last outputs. With ``feat_quant`` ("bfloat16"/"int8"),
+    ``features`` IS the quantized table (aliased output — the per-tick
+    ``fq_rows`` delta rows scatter into it in-kernel; ``feat_scale`` is
+    the int8 per-column scale from :func:`quantize_features`).
+
+    Returns the resident tick's 8-tuple + ``(h_a, h_b)`` (+ the updated
+    quant table when ``feat_quant``). Serving-only: not differentiable.
+    The f32 path is bit-identical to the resident/composed tick."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    offs = tuple(int(o) for o in rel_offsets or ())
+    if len(offs) < 2 or offs[-1] <= 0 or not tiles_align(offs):
+        raise ValueError(
+            "pallas_fused_gnn_tick_dma needs a non-empty "
+            "EDGE_TILE-aligned relation-bucketed layout")
+    if feat_quant not in ("", "bfloat16", "int8"):
+        raise ValueError(f"unsupported feat_quant: {feat_quant!r}")
+    pn = int(features.shape[0])
+    nb = min(int(node_block), pn)
+    if pn % nb != 0:
+        raise ValueError(
+            f"node count {pn} must be a multiple of the DMA node block "
+            f"{nb} (both come off power-of-two bucket ladders)")
+    if feat_quant in ("bfloat16", "int8") and fq_rows is None:
+        raise ValueError("feat_quant tiers need the per-tick fq_rows")
+    if feat_quant == "int8" and feat_scale is None:
+        raise ValueError("int8 feat_quant needs the per-column scale")
+    cdt = None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    return _dma_forward(int(pk), int(ek), int(pi), offs, nb, feat_quant,
+                        cdt, bool(interpret), params, features, kind,
+                        nmask, esrc, edst, erel, emask, ints, h_a, h_b,
+                        fq_rows, feat_scale)

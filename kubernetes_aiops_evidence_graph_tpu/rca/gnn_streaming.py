@@ -125,10 +125,12 @@ def _gnn_tick(params, features, kind, nmask, esrc, edst, erel, emask, ints,
     return kind, nmask, esrc, edst, erel, emask, logits, probs
 
 
-@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets"),
+@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
+                                   "compute_dtype"),
          donate_argnums=(2, 3, 4, 5, 6, 7))
 def _gnn_fused_tick(params, features, kind, nmask, esrc, edst, erel, emask,
-                    ints, pk: int, ek: int, pi: int, rel_offsets=None):
+                    ints, pk: int, ek: int, pi: int, rel_offsets=None,
+                    compute_dtype=None):
     """graft-fuse: the fused streaming tick (settings.gnn_fused_tick) —
     the SAME operand layout, donation contract and return tuple as
     :func:`_gnn_tick`, but delta scatter, message pass and score
@@ -136,13 +138,66 @@ def _gnn_fused_tick(params, features, kind, nmask, esrc, edst, erel, emask,
     (ops/pallas_segment.pallas_fused_gnn_tick): the [N, H] activations
     stay VMEM-resident across stages instead of round-tripping through
     HBM between the scatter, each message-pass layer and the readout.
-    BIT-identical to the composed tick (the parity oracle); f32,
-    EDGE_TILE-aligned bucketed layouts only — the dispatcher keeps the
-    composed tick for every other configuration."""
+    BIT-identical to the composed tick (the parity oracle) in f32;
+    ``compute_dtype="bfloat16"`` (graft-tide) runs the matmul operands
+    in bf16 with f32 accumulation — tolerance-gated against the f32
+    oracle, same fold order. EDGE_TILE-aligned bucketed layouts only —
+    the dispatcher keeps the composed tick for every other
+    configuration."""
     from ..ops.pallas_segment import pallas_fused_gnn_tick
     return pallas_fused_gnn_tick(params, features, kind, nmask, esrc,
                                  edst, erel, emask, ints, pk=pk, ek=ek,
-                                 pi=pi, rel_offsets=rel_offsets)
+                                 pi=pi, rel_offsets=rel_offsets,
+                                 compute_dtype=compute_dtype)
+
+
+@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
+                                   "node_block", "compute_dtype"),
+         donate_argnums=(2, 3, 4, 5, 6, 7, 9, 10))
+def _gnn_dma_tick(params, features, kind, nmask, esrc, edst, erel, emask,
+                  ints, h_a, h_b, pk: int, ek: int, pi: int,
+                  rel_offsets=None, node_block: int = 2048,
+                  compute_dtype=None):
+    """graft-tide: the beyond-VMEM streaming tick (settings.gnn_tick_dma)
+    — same operand layout, delta semantics and leading 8-tuple as
+    :func:`_gnn_tick`, but features, the edge mirror and the [N, H]
+    activations stay HBM-resident and stream through double-buffered
+    VMEM windows (ops/pallas_segment.pallas_fused_gnn_tick_dma). The
+    donated set grows by the two ``h_a``/``h_b`` activation ping-pong
+    buffers — pure per-tick scratch the scorer keeps across ticks
+    (``_dma_h``) so they are never reallocated; they return as outputs
+    8/9. ``features`` is NOT donated (the base scorer's resident f32
+    buffer — the quantized tiers use :func:`_gnn_dma_tick_q` instead).
+    f32 path bit-identical to the composed oracle; serving-only."""
+    from ..ops.pallas_segment import pallas_fused_gnn_tick_dma
+    return pallas_fused_gnn_tick_dma(
+        params, features, kind, nmask, esrc, edst, erel, emask, ints,
+        h_a, h_b, pk=pk, ek=ek, pi=pi, rel_offsets=rel_offsets,
+        node_block=node_block, compute_dtype=compute_dtype)
+
+
+@partial(jax.jit, static_argnames=("pk", "ek", "pi", "rel_offsets",
+                                   "node_block", "compute_dtype",
+                                   "feat_quant"),
+         donate_argnums=(1, 2, 3, 4, 5, 6, 7, 9, 10))
+def _gnn_dma_tick_q(params, features_q, kind, nmask, esrc, edst, erel,
+                    emask, ints, h_a, h_b, fq_rows, feat_scale,
+                    pk: int, ek: int, pi: int, rel_offsets=None,
+                    node_block: int = 2048, compute_dtype=None,
+                    feat_quant: str = "int8"):
+    """graft-tide quantized tiers of :func:`_gnn_dma_tick`: the node
+    feature table is the HBM-resident bf16/int8 mirror ``features_q``
+    (DONATED — the per-tick ``fq_rows`` delta rows scatter into it
+    in-kernel and the updated table returns as output 10, so the quant
+    mirror flows through like the edge mirror does). ``feat_scale`` is
+    the int8 per-column scale (None for bf16); embeds dequantize and
+    accumulate in f32. Tolerance-gated vs the f32 oracle."""
+    from ..ops.pallas_segment import pallas_fused_gnn_tick_dma
+    return pallas_fused_gnn_tick_dma(
+        params, features_q, kind, nmask, esrc, edst, erel, emask, ints,
+        h_a, h_b, pk=pk, ek=ek, pi=pi, rel_offsets=rel_offsets,
+        node_block=node_block, compute_dtype=compute_dtype,
+        feat_quant=feat_quant, fq_rows=fq_rows, feat_scale=feat_scale)
 
 
 class GnnStreamingScorer(StreamingScorer):
@@ -199,6 +254,33 @@ class GnnStreamingScorer(StreamingScorer):
         # hop bit-identical. f32 bucketed layouts only; the dispatcher
         # falls back to the composed tick otherwise (_fused_ok).
         self._use_fused = bool(getattr(cfg, "gnn_fused_tick", False))
+        # graft-tide: the beyond-VMEM DMA streaming tier
+        # (settings.gnn_tick_dma) — features, edge mirror and [N, H]
+        # activations HBM-resident, streamed through double-buffered
+        # VMEM windows. Auto-selected per dispatch once the resident
+        # fused tick's closed-form VMEM demand exceeds the soft budget,
+        # or whenever a quantized feature tier is on (_dma_ok). Sits
+        # ABOVE fused on the shield's kernel-fallback rung:
+        # dma → fused → composed(Pallas/XLA) → XLA.
+        self._use_dma = bool(getattr(cfg, "gnn_tick_dma", False))
+        self._vmem_budget = int(getattr(cfg, "vmem_budget_bytes",
+                                        8 * 2 ** 20))
+        self._dma_node_block = int(getattr(cfg, "gnn_dma_node_block", 2048))
+        self._feat_quant = str(getattr(cfg, "gnn_feature_quant", "") or "")
+        # persistent DMA activation ping-pong scratch (donated + rebound
+        # every DMA tick — content is pure per-tick scratch, fully
+        # rewritten in-kernel, so rebinding on warm shapes is safe)
+        self._dma_h: "tuple | None" = None
+        # quantized feature mirror (HBM-resident table + per-column
+        # scale), re-derived deterministically from host truth at every
+        # mirror (re)build / shield restore (_quant_refresh)
+        self._features_q_dev = None
+        self._feat_scale_dev = None
+        self._feat_scale_host = None
+        # transient per-dispatch stash: the quantized delta rows the next
+        # DMA tick scatters into the quant table (consumed by
+        # _dispatch_dma; zeros for warm calls)
+        self._dma_stage_fq = None
         # transient per-dispatch stash: the packed GNN delta the staged
         # slab should carry (single-transfer satellite; see dispatch)
         self._gnn_stage = None
@@ -227,12 +309,12 @@ class GnnStreamingScorer(StreamingScorer):
 
     def _fused_ok(self, rel_offsets=None) -> bool:
         """Whether the fused Pallas tick can serve the CURRENT (or given)
-        layout: fused tier on, bucketed f32 math, a non-empty
-        EDGE_TILE-aligned slice table, single-device mirror. Everything
-        else keeps the composed tick — same verdicts, different
-        lowering."""
+        layout: fused tier on, bucketed f32 (or, since graft-tide, bf16)
+        math, a non-empty EDGE_TILE-aligned slice table, single-device
+        mirror. Everything else keeps the composed tick — same verdicts,
+        different lowering."""
         if not (self._use_fused and self._use_bucketed
-                and not self._compute_dtype
+                and self._compute_dtype in (None, "bfloat16")
                 and not getattr(self, "_mirror_sharded", False)):
             return False
         from ..ops.pallas_segment import tiles_align
@@ -241,18 +323,115 @@ class GnnStreamingScorer(StreamingScorer):
         return (len(offs) >= 2 and int(offs[-1]) > 0
                 and tiles_align(offs))
 
+    def _tick_vmem_demand(self, args: tuple, pk: int, ek: int,
+                          pi: int) -> int:
+        """Closed-form VMEM working set the RESIDENT fused tick would
+        need for these operands (ops/pallas_segment.fused_tick_vmem_bytes)
+        — the dispatcher compares it against settings.vmem_budget_bytes
+        to auto-select the DMA streaming tier."""
+        from ..ops.pallas_segment import fused_tick_vmem_bytes
+        params, features = args[0], args[1]
+        layers = params["layers"]
+        return fused_tick_vmem_bytes(
+            pn=int(features.shape[0]), pe=int(args[4].shape[0]),
+            dim=int(features.shape[1]),
+            hidden=int(params["embed_b"].shape[0]),
+            classes=int(params["head_b"].shape[0]),
+            num_kinds=int(params["kind_emb"].shape[0]),
+            num_rels=int(layers[0]["w_rel"].shape[0]),
+            num_layers=len(layers), pk=pk, ek=ek, pi=pi)
+
+    def _dma_ok(self, args: tuple, pk: int, ek: int, pi: int,
+                rel_offsets=None) -> bool:
+        """Whether the DMA streaming tick serves these operands: DMA tier
+        on, bucketed single-device layout, f32/bf16 compute, a non-empty
+        EDGE_TILE-aligned slice table, and EITHER a quantized feature
+        tier is selected (the quant table is HBM-resident by
+        construction) OR the resident tick's closed-form VMEM demand
+        exceeds the soft budget — small graphs keep the (cheaper, bit-
+        identical) resident kernel."""
+        if not (self._use_dma and self._use_bucketed
+                and self._compute_dtype in (None, "bfloat16")
+                and not getattr(self, "_mirror_sharded", False)):
+            return False
+        from ..ops.pallas_segment import tiles_align
+        offs = rel_offsets if rel_offsets is not None \
+            else getattr(self, "_rel_offsets", ())
+        if not (len(offs) >= 2 and int(offs[-1]) > 0
+                and tiles_align(offs)):
+            return False
+        pn = int(args[1].shape[0])
+        if pn % min(self._dma_node_block, pn) != 0:
+            return False
+        if self._feat_quant:
+            return True
+        return self._tick_vmem_demand(args, pk, ek, pi) > self._vmem_budget
+
+    def _dispatch_dma(self, args: tuple, pk: int, ek: int, pi: int,
+                      offs, live: bool):
+        """Run one DMA streaming tick. ``live`` marks a real dispatch:
+        the persistent activation scratch (``_dma_h``) and, under a quant
+        tier, the resident quant table are donated and rebound from the
+        outputs; warm calls get same-aval stand-ins so they compile the
+        exact serving executable without touching resident state."""
+        params, features = args[0], args[1]
+        pn = int(features.shape[0])
+        dim = int(features.shape[1])
+        hidden = int(params["embed_b"].shape[0])
+        nb = min(self._dma_node_block, pn)
+        h = self._dma_h if live else None
+        if h is None or tuple(h[0].shape) != (pn, hidden):
+            h = (jnp.zeros((pn, hidden), jnp.float32),
+                 jnp.zeros((pn, hidden), jnp.float32))
+        if live:
+            self._dma_h = None   # donated below; rebound from the outputs
+        if not self._feat_quant:
+            out = _gnn_dma_tick(*args, *h, pk=pk, ek=ek, pi=pi,
+                                rel_offsets=offs, node_block=nb,
+                                compute_dtype=self._compute_dtype)
+            if live:
+                self._dma_h = (out[8], out[9])
+            return out[:8]
+        qdt = jnp.int8 if self._feat_quant == "int8" else jnp.bfloat16
+        fq_rows = None
+        if live:
+            qtable, scale = self._features_q_dev, self._feat_scale_dev
+            fq_rows, self._dma_stage_fq = self._dma_stage_fq, None
+            self._features_q_dev = None   # donated; rebound below
+        else:
+            qtable = jnp.zeros((pn, dim), qdt)
+            scale = (jnp.ones((dim,), jnp.float32)
+                     if self._feat_quant == "int8" else None)
+        if fq_rows is None or int(fq_rows.shape[0]) != pk:
+            fq_rows = jnp.zeros((pk, dim), qdt)
+        out = _gnn_dma_tick_q(params, qtable, *args[2:], *h, fq_rows,
+                              scale, pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+                              node_block=nb,
+                              compute_dtype=self._compute_dtype,
+                              feat_quant=self._feat_quant)
+        if live:
+            self._features_q_dev = out[10]
+            self._dma_h = (out[8], out[9])
+        return out[:8]
+
     def _call_gnn_tick(self, args: tuple, pk: int, ek: int, pi: int,
-                       rel_offsets=None, slices_sorted=None):
+                       rel_offsets=None, slices_sorted=None,
+                       live: bool = False):
         """Run (or warm) ONE single-device GNN tick at the given shapes
-        through the tier the settings select — the fused Pallas kernel
-        when the layout admits it, the composed scatter→forward tick
-        otherwise. Single seam so dispatch and every warm path compile
-        exactly the variant serving will run. Returns the 8-tuple."""
+        through the tier the settings select — the DMA streaming kernel
+        when the operands outgrow VMEM (or a quant tier is on), the
+        fused Pallas kernel when the layout admits it, the composed
+        scatter→forward tick otherwise. Single seam so dispatch and
+        every warm path compile exactly the variant serving will run.
+        Returns the 8-tuple."""
         offs = rel_offsets if rel_offsets is not None \
             else self._rel_offsets
+        if self._dma_ok(args, pk, ek, pi, offs):
+            return self._dispatch_dma(args, pk, ek, pi, offs, live)
         if self._fused_ok(offs):
             return _gnn_fused_tick(*args, pk=pk, ek=ek, pi=pi,
-                                   rel_offsets=offs)
+                                   rel_offsets=offs,
+                                   compute_dtype=self._compute_dtype)
         statics = self._tick_statics(rel_offsets=offs,
                                      slices_sorted=slices_sorted)
         return _gnn_tick(*args, pk=pk, ek=ek, pi=pi, **statics)
@@ -416,6 +595,47 @@ class GnnStreamingScorer(StreamingScorer):
         self._slices_sorted = True
         self._last_gnn: tuple | None = None
         self._apply_sharding()   # place the fresh mirror on the mesh
+        self._quant_refresh()    # graft-tide: re-derive the quant mirror
+
+    # -- graft-tide: quantized feature mirror ------------------------------
+
+    def _quant_refresh(self) -> None:
+        """(Re)derive the HBM-resident quantized feature table + per-
+        column scale from host-truth features — at every mirror
+        (re)build and at shield restore adoption. Deterministic given
+        the snapshot, so a restore reproduces the exact serving table
+        without packing it into the shield snapshot. The scale freezes
+        until the next refresh; per-tick delta rows quantize against the
+        frozen scale (clipped — within the tier's tolerance contract)."""
+        if not self._feat_quant or getattr(self, "_mirror_sharded", False):
+            self._features_q_dev = None
+            self._feat_scale_dev = None
+            self._feat_scale_host = None
+            return
+        from ..ops.pallas_segment import quantize_features
+        q, scale = quantize_features(
+            jnp.asarray(self.snapshot.features), self._feat_quant)
+        self._features_q_dev = q
+        self._feat_scale_dev = scale
+        self._feat_scale_host = None if scale is None else np.asarray(scale)
+
+    def _quant_rows(self, rows: list, pk: int):
+        """The per-tick quantized feature delta: the aux rows' CURRENT
+        host-truth features quantized against the frozen per-column
+        scale, padded to the [pk, dim] delta bucket — scattered into the
+        HBM-resident quant table in-kernel (same f_idx slots as the aux
+        delta; padding drops)."""
+        dim = self.snapshot.features.shape[1]
+        out = np.zeros((pk, dim), np.float32)
+        if rows:
+            out[:len(rows)] = self.snapshot.features[rows]
+        if self._feat_quant == "bfloat16":
+            return jnp.asarray(out).astype(jnp.bfloat16)
+        scale = self._feat_scale_host
+        safe = np.where(scale > 0, scale, 1.0)
+        q = np.clip(np.round(out / safe[None, :]), -127, 127)
+        q = np.where(scale[None, :] > 0, q, 0.0)
+        return jnp.asarray(q.astype(np.int8))
 
     # -- journal-driven mirror maintenance --------------------------------
 
@@ -766,11 +986,15 @@ class GnnStreamingScorer(StreamingScorer):
                 treedef, [jnp.asarray(p) for p in parts[m:]])
             self._params_prev = None
         self._last_gnn = None
+        self._dma_h = None   # scratch: shapes may differ post-restore
         # the base call placed only ITS arrays (the mirror handles still
         # held pre-restore buffers then); re-place now that the restored
         # mirror is installed — device_put with an unchanged sharding is
         # free, so the unsharded path costs nothing
         self._apply_sharding()
+        # graft-tide: the quant mirror re-derives from the restored host
+        # truth (deterministic) instead of riding the packed snapshot
+        self._quant_refresh()
 
     def _apply_sharding(self) -> None:
         super()._apply_sharding()
@@ -863,6 +1087,10 @@ class GnnStreamingScorer(StreamingScorer):
              probs) = tick(*args)
         else:
             ints, pk, ek = self._packed_gnn_delta(aux_rows)
+            if self._feat_quant:
+                # graft-tide: the quantized delta rows ride beside the
+                # packed ints — consumed by _dispatch_dma this tick
+                self._dma_stage_fq = self._quant_rows(aux_rows, pk)
             columnar = isinstance(self._pending_feat, FeatureStage)
             self._gnn_stage = ints if columnar else None
             try:
@@ -880,36 +1108,91 @@ class GnnStreamingScorer(StreamingScorer):
             args = (self._params, self._features_dev, self._kind_dev,
                     self._nmask_dev, self._esrc_dev, self._edst_dev,
                     self._erel_dev, self._emask_dev, ints_dev)
-            if self._fused_ok():
-                scope_tick = partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi,
-                                     rel_offsets=self._rel_offsets)
-            else:
-                scope_tick = partial(_gnn_tick, pk=pk, ek=ek, pi=pi,
-                                     **self._tick_statics())
-            self._scope_gnn(span, False, pk, ek, scope_tick, args)
+            self._scope_gnn(span, False, pk, ek, None, args)
             (self._kind_dev, self._nmask_dev, self._esrc_dev,
              self._edst_dev, self._erel_dev, self._emask_dev, logits,
-             probs) = self._call_gnn_tick(args, pk, ek, pi)
+             probs) = self._call_gnn_tick(args, pk, ek, pi, live=True)
         self._last_gnn = (self.params_generation, logits, probs)
         if span is not None:
             span.mark("gnn_dispatch")
         return out
+
+    def _tick_entrypoint(self, args, pk: int, ek: int, pi: int,
+                         sharded: bool = False) -> str:
+        """Cost-model entrypoint of the tick variant ``_call_gnn_tick``
+        would DISPATCH for these operands (graft-tide satellite): the
+        roofline resolves its model from the variant actually serving —
+        the DMA/bf16/int8 tiers price HBM tile traffic where the
+        resident tiers price whole-operand reads, so labeling them all
+        ``streaming.gnn_tick.fused`` would chart the wrong ceiling."""
+        if sharded:
+            return "streaming.gnn_tick.sharded"
+        if self._dma_ok(args, pk, ek, pi):
+            if self._feat_quant == "int8":
+                return "streaming.gnn_tick.dma.int8"
+            if self._feat_quant == "bfloat16":
+                return "streaming.gnn_tick.dma.bf16"
+            return "streaming.gnn_tick.dma"
+        if self._fused_ok():
+            return ("streaming.gnn_tick.fused.bf16"
+                    if self._compute_dtype == "bfloat16"
+                    else "streaming.gnn_tick.fused")
+        return ("streaming.gnn_tick.bucketed" if self._use_bucketed
+                else "streaming.gnn_tick")
+
+    def _scope_tick_fn(self, entry: str, args, pk: int, ek: int, pi: int):
+        """(callable, operands) matching the dispatched variant for the
+        roofline's abstract trace. The DMA tiers take extra operands the
+        composed layout doesn't carry (activation scratch, quant delta);
+        stand-ins ride as ShapeDtypeStructs — the trace never touches
+        resident buffers."""
+        offs = self._rel_offsets
+        if entry.startswith("streaming.gnn_tick.dma"):
+            params, features = args[0], args[1]
+            pn, dim = int(features.shape[0]), int(features.shape[1])
+            hidden = int(params["embed_b"].shape[0])
+            nb = min(self._dma_node_block, pn)
+            h = jax.ShapeDtypeStruct((pn, hidden), jnp.float32)
+            if not self._feat_quant:
+                return (partial(_gnn_dma_tick, pk=pk, ek=ek, pi=pi,
+                                rel_offsets=offs, node_block=nb,
+                                compute_dtype=self._compute_dtype),
+                        args + (h, h))
+            qdt = jnp.int8 if self._feat_quant == "int8" else jnp.bfloat16
+            qtable = jax.ShapeDtypeStruct((pn, dim), qdt)
+            fq = jax.ShapeDtypeStruct((pk, dim), qdt)
+            scale = (jax.ShapeDtypeStruct((dim,), jnp.float32)
+                     if self._feat_quant == "int8" else None)
+            return (partial(_gnn_dma_tick_q, pk=pk, ek=ek, pi=pi,
+                            rel_offsets=offs, node_block=nb,
+                            compute_dtype=self._compute_dtype,
+                            feat_quant=self._feat_quant),
+                    (params, qtable) + tuple(args[2:]) + (h, h, fq, scale))
+        if entry.startswith("streaming.gnn_tick.fused"):
+            return (partial(_gnn_fused_tick, pk=pk, ek=ek, pi=pi,
+                            rel_offsets=offs,
+                            compute_dtype=self._compute_dtype), args)
+        return (partial(_gnn_tick, pk=pk, ek=ek, pi=pi,
+                        **self._tick_statics()), args)
 
     def _scope_gnn(self, span, sharded: bool, pk: int, ek: int,
                    tick, args) -> None:
         """Roofline-model the GNN tick at its live compiled shapes (cached
         per shape key; abstract trace — the donated mirrors are not
         consumed). The GNN tick supersedes the rules tick as the roofline
-        entrypoint this scorer reports: its verdict is the one served."""
+        entrypoint this scorer reports: its verdict is the one served.
+        ``tick=None`` (the single-device path) resolves the traced
+        callable from the variant _call_gnn_tick would dispatch."""
         if span is None:
             return
-        self._scope_entry = ("streaming.gnn_tick.sharded" if sharded
-                             else ("streaming.gnn_tick.fused"
-                                   if self._fused_ok()
-                                   else "streaming.gnn_tick"))
-        self._scope_key = (self.snapshot.padded_nodes,
-                           self.snapshot.padded_incidents,
+        pi = self.snapshot.padded_incidents
+        self._scope_entry = self._tick_entrypoint(args, pk, ek, pi,
+                                                  sharded=sharded)
+        self._scope_key = (self.snapshot.padded_nodes, pi,
                            int(self._esrc_dev.shape[0]), pk, ek, sharded)
+        if tick is None:
+            tick, args = self._scope_tick_fn(self._scope_entry, args,
+                                             pk, ek, pi)
         obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
                                  tick, args)
 

@@ -576,11 +576,16 @@ class ShieldedScorer:
         State-suspect failures pair every configuration-only rung with a
         journal replay — no config change can restage lost deltas."""
         if step == "kernel_fallback":
-            # graft-fuse: the fused tick sits ABOVE the Pallas tier on
-            # this rung — fused → composed (Pallas) → XLA, every hop
-            # bit-identical (PR 4 / PR 14): degrading the lowering can
-            # change which kernel faults, never verdicts
-            if getattr(self.scorer, "_use_fused", False):
+            # graft-tide/graft-fuse: the DMA streaming tick sits at the
+            # TOP of this rung — dma → fused → composed (Pallas) → XLA
+            # (PR 4 / PR 14 / PR 16): degrading the lowering can change
+            # which kernel faults, never verdicts (the f32 hops are
+            # bit-identical; a quantized tier degrades with its table —
+            # the resident tiers read the f32 features, so the fallback
+            # verdict is the f32 one the tolerance contract is gated on)
+            if getattr(self.scorer, "_use_dma", False):
+                self.scorer._use_dma = False
+            elif getattr(self.scorer, "_use_fused", False):
                 self.scorer._use_fused = False
             elif getattr(self.scorer, "_use_pallas", False):
                 self.scorer._use_pallas = False

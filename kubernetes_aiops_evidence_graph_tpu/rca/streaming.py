@@ -45,7 +45,10 @@ from ..graph.store import EvidenceGraphStore
 from ..utils.padding import bucket_for
 from .tpu_backend import _PAIR_WIDTH_BUCKETS, _WIDTH_BUCKETS
 
-_DELTA_BUCKETS = (64, 256, 1024, 4096, 16384)
+# graft-tide appended the 65536 rung for 500k-pod churn bursts (the
+# coalesced-tick registry entry keys its canonical shape off the top
+# rung, so its cost baseline was re-derived with the stretch)
+_DELTA_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
 _ROW_BUCKETS = (4, 16, 64, 256)
 
 _NO_PAIR = -1          # host-side "evidence has no scheduled node" marker

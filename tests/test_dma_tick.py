@@ -1,0 +1,415 @@
+"""graft-tide: the beyond-VMEM DMA streaming tick + quantized tiers.
+
+Acceptance pins (ISSUE 16): the double-buffered HBM->VMEM DMA tick is
+BIT-identical to the composed scatter->pallas-gms->score oracle on the
+f32 path (same fold order as the resident fused tick), across node
+blocks, all-padding slices, and empty-delta ticks; the bf16/int8
+quantized feature tiers hold tolerance against the f32 oracle with
+zero-scale columns quantizing to exact zero; the resident tier's VMEM
+guard REFUSES beyond-VMEM shapes (the dispatcher's reason to stream);
+the dispatcher auto-selects the DMA tier past settings.vmem_budget_bytes
+and resolves the scope entrypoint to the dispatched variant; warm paths
+pre-compile the exact DMA executable serving dispatches (zero live
+compiles after warm).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+    cost_jaxpr,
+)
+from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+    CompileCounter,
+)
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph.schema import DIM
+from kubernetes_aiops_evidence_graph_tpu.ops.pallas_segment import (
+    dma_tick_traffic_floor, fused_tick_vmem_bytes, pallas_fused_gnn_tick,
+    pallas_fused_gnn_tick_dma, quantize_features,
+)
+from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+    GnnStreamingScorer, _gnn_dma_tick, _gnn_dma_tick_q, _gnn_tick,
+)
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, stream_step,
+)
+
+from tests.test_fused_tick import _fresh, _random_tick_operands
+from tests.test_streaming import _world
+
+_BUCKETS = dict(node_bucket_sizes=(512, 2048),
+                edge_bucket_sizes=(2048, 8192),
+                incident_bucket_sizes=(8, 32))
+
+_OUT_NAMES = ("kind", "nmask", "esrc", "edst", "erel", "emask",
+              "logits", "probs")
+
+
+def _h_pair(pn, hidden=16):
+    return (jnp.zeros((pn, hidden), jnp.float32),
+            jnp.zeros((pn, hidden), jnp.float32))
+
+
+def _oracle(p, features, mirrors, ints, offs, kw):
+    return _gnn_tick(p, jnp.asarray(features), *_fresh(mirrors),
+                     jnp.asarray(ints), rel_offsets=offs,
+                     slices_sorted=False, compute_dtype=None, pallas=True,
+                     **kw)
+
+
+# -- kernel level -----------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_dma_kernel_bit_identical_to_composed_tick(seed):
+    """f32 acceptance: every resident output — six scattered mirror
+    arrays, logits AND masked probs — bit-equal to the composed oracle;
+    the fold order the DMA streaming must not have changed."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(seed)
+    a = _oracle(p, features, mirrors, ints, offs, kw)
+    b = pallas_fused_gnn_tick_dma(
+        p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        *_h_pair(features.shape[0]), rel_offsets=offs, node_block=64, **kw)
+    for name, x, y in zip(_OUT_NAMES, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+    assert np.isfinite(np.asarray(b[8])).all()   # streamed h_a
+
+
+@pytest.mark.parametrize("node_block", [32, 128, 256])
+def test_dma_kernel_invariant_to_node_block(node_block):
+    """The VMEM window size is a perf knob, never a numerics knob."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(7)
+    ref = pallas_fused_gnn_tick_dma(
+        p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        *_h_pair(features.shape[0]), rel_offsets=offs, node_block=64, **kw)
+    got = pallas_fused_gnn_tick_dma(
+        p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        *_h_pair(features.shape[0]), rel_offsets=offs,
+        node_block=node_block, **kw)
+    for name, x, y in zip(_OUT_NAMES, ref, got):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def test_dma_kernel_all_padding_slices_match_oracle():
+    """Slices with zero live edges (emask all padding) stream through
+    the same tiles and must stay bit-equal — padding rows fold as
+    masked zeros, never as garbage."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(
+        11, live=(0, 0, 0))
+    a = _oracle(p, features, mirrors, ints, offs, kw)
+    b = pallas_fused_gnn_tick_dma(
+        p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+        *_h_pair(features.shape[0]), rel_offsets=offs, node_block=64, **kw)
+    for name, x, y in zip(_OUT_NAMES, a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def _empty_ints(pk, ek, pi, pn, pe):
+    ints = np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32)
+    ints[:pk] = pn                     # aux sentinel rows: all dropped
+    ints[3 * pk:3 * pk + ek] = pe      # edge-slot sentinels: all dropped
+    return ints
+
+
+@pytest.mark.parametrize("feat_quant", ["", "bfloat16", "int8"])
+def test_empty_delta_tick_preserves_mirrors_per_tier(feat_quant):
+    """A tick with an all-sentinel delta must return the mirrors
+    bit-unchanged under every tier — an empty delta that perturbs
+    resident state would corrupt serving between re-mirrors."""
+    p, features, mirrors, _, offs, kw = _random_tick_operands(13)
+    pn = features.shape[0]
+    ints = _empty_ints(kw["pk"], kw["ek"], kw["pi"], pn, offs[-1])
+    if not feat_quant:
+        out = pallas_fused_gnn_tick_dma(
+            p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+            *_h_pair(pn), rel_offsets=offs, node_block=64, **kw)
+    else:
+        q, scale = quantize_features(jnp.asarray(features), feat_quant)
+        fq = jnp.zeros((kw["pk"], DIM), q.dtype)
+        out = pallas_fused_gnn_tick_dma(
+            p, q, *_fresh(mirrors), jnp.asarray(ints), *_h_pair(pn),
+            rel_offsets=offs, node_block=64, feat_quant=feat_quant,
+            fq_rows=fq, feat_scale=scale, **kw)
+        # the delta scatter saw only sentinels: table returned bit-intact
+        assert np.array_equal(np.asarray(out[10]), np.asarray(q))
+    for name, x, y in zip(_OUT_NAMES[:6], mirrors, out):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+    assert np.isfinite(np.asarray(out[7])).all()
+    if not feat_quant:
+        a = _oracle(p, features, mirrors, ints, offs, kw)
+        assert np.array_equal(np.asarray(a[7]), np.asarray(out[7]))
+
+
+def _quantized_aux_rows(q, scale, ints, pk):
+    """What serving stages: each LIVE aux delta row quantized against
+    the frozen table scale (here: copied from the already-quantized
+    table, which is the same thing for unchanged features)."""
+    qnp = np.asarray(q)
+    fq = np.zeros((pk, DIM), qnp.dtype)
+    live = np.asarray(ints[2 * pk:3 * pk]) == 1
+    rows = np.asarray(ints[:pk])
+    for i in range(pk):
+        if live[i]:
+            fq[i] = qnp[rows[i]]
+    return jnp.asarray(fq)
+
+
+@pytest.mark.parametrize("feat_quant,tol", [("bfloat16", 0.05),
+                                            ("int8", 0.1)])
+def test_quantized_tiers_hold_probs_tolerance_vs_f32_oracle(feat_quant,
+                                                            tol):
+    """Two-sided contract: the quantized tick is BIT-identical to the
+    composed oracle fed the dequantized table (the tick itself adds no
+    error — only quantization does), and the quantization loss keeps
+    probs within the tier tolerance of the raw-f32 oracle without
+    flipping the argmax on this layout."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(3)
+    pn, pk = features.shape[0], kw["pk"]
+    q, scale = quantize_features(jnp.asarray(features), feat_quant)
+    deq = (np.asarray(q, np.float32) * np.asarray(scale)[None, :]
+           if feat_quant == "int8" else np.asarray(q, np.float32))
+    out = pallas_fused_gnn_tick_dma(
+        p, q, *_fresh(mirrors), jnp.asarray(ints), *_h_pair(pn),
+        rel_offsets=offs, node_block=64, feat_quant=feat_quant,
+        fq_rows=_quantized_aux_rows(q, scale, ints, pk),
+        feat_scale=scale, **kw)
+    exact = _oracle(p, deq, mirrors, ints, offs, kw)
+    for name, x, y in zip(_OUT_NAMES, exact, out):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+    probs_f32 = np.asarray(_oracle(p, features, mirrors, ints, offs,
+                                   kw)[7])
+    probs_q = np.asarray(out[7])
+    assert np.abs(probs_f32 - probs_q).max() < tol
+    assert (probs_f32.argmax(-1) == probs_q.argmax(-1)).all()
+
+
+def test_quantize_roundtrip_vs_f64_oracle():
+    """Per-column absmax int8: |dequant - x| <= scale/2 in f64; bf16:
+    one-in-256 relative error. The bound is the contract the serving
+    tolerance gates are derived from."""
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((512, DIM))
+         * 10.0 ** rng.integers(-3, 3, (512, DIM))).astype(np.float32)
+    q8, scale = quantize_features(jnp.asarray(x), "int8")
+    deq = np.asarray(q8, np.float64) * np.asarray(scale, np.float64)
+    assert np.all(np.abs(deq - x.astype(np.float64))
+                  <= np.asarray(scale, np.float64) / 2 + 1e-12)
+    qb, scale_b = quantize_features(jnp.asarray(x), "bfloat16")
+    assert scale_b is None
+    rel = np.abs(np.asarray(qb, np.float64) - x.astype(np.float64))
+    assert np.all(rel <= np.abs(x.astype(np.float64)) * 2.0 ** -8 + 1e-12)
+
+
+def test_zero_scale_columns_quantize_to_exact_zero():
+    """An all-zero feature column gets scale 0 and q 0 — no epsilon
+    fudge, no NaN from a 0/0, dequant exactly 0.0 — and the tick still
+    serves finite probs over such a table."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(9)
+    features = features.copy()
+    features[:, 3] = 0.0
+    features[:, 17] = 0.0
+    q, scale = quantize_features(jnp.asarray(features), "int8")
+    assert float(np.asarray(scale)[3]) == 0.0
+    assert float(np.asarray(scale)[17]) == 0.0
+    assert not np.asarray(q)[:, 3].any()
+    assert not np.asarray(q)[:, 17].any()
+    out = pallas_fused_gnn_tick_dma(
+        p, q, *_fresh(mirrors), jnp.asarray(ints),
+        *_h_pair(features.shape[0]), rel_offsets=offs, node_block=64,
+        feat_quant="int8", fq_rows=jnp.zeros((kw["pk"], DIM), jnp.int8),
+        feat_scale=scale, **kw)
+    assert np.isfinite(np.asarray(out[7])).all()
+
+
+def test_resident_vmem_guard_refuses_beyond_vmem_shapes():
+    """The resident fused tick must REFUSE a shape whose VMEM demand
+    exceeds the placement limit — that refusal is what routes serving
+    onto the DMA tier; the DMA tick must trace the same shape."""
+    p = gnn.init_params(jax.random.PRNGKey(0), hidden=64, layers=3)
+    pn, pi, pk, ek = 65536, 32, 64, 64
+    caps = (2048,) * 8
+    offs = (0,) + tuple(int(c) for c in np.cumsum(caps))
+    pe = offs[-1]
+    demand = fused_tick_vmem_bytes(
+        pn=pn, pe=pe, dim=DIM, hidden=64, classes=gnn.NUM_CLASSES,
+        num_kinds=p["kind_emb"].shape[0], num_rels=len(caps),
+        num_layers=3, pk=pk, ek=ek, pi=pi)
+    assert demand > 16 * 2 ** 20
+    sds = jax.ShapeDtypeStruct
+    args = (p, sds((pn, DIM), jnp.float32), sds((pn,), jnp.int32),
+            sds((pn,), jnp.float32), sds((pe,), jnp.int32),
+            sds((pe,), jnp.int32), sds((pe,), jnp.int32),
+            sds((pe,), jnp.float32),
+            np.zeros(3 * pk + 5 * ek + 2 * pi, np.int32))
+    with pytest.raises(ValueError, match="VMEM"):
+        jax.make_jaxpr(lambda *a: pallas_fused_gnn_tick(
+            *a, pk=pk, ek=ek, pi=pi, rel_offsets=offs))(*args)
+    h = sds((pn, 64), jnp.float32)
+    jaxpr = jax.make_jaxpr(lambda *a: pallas_fused_gnn_tick_dma(
+        *a[:9], a[9], a[10], pk=pk, ek=ek, pi=pi, rel_offsets=offs,
+        node_block=2048))(*args, h, h)
+    assert jaxpr is not None
+
+
+def test_dma_kernel_rejects_bad_layouts():
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(5)
+    pn = features.shape[0]
+    with pytest.raises(ValueError):       # off the EDGE_TILE ladder
+        pallas_fused_gnn_tick_dma(
+            p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+            *_h_pair(pn), rel_offsets=(0, 3), node_block=64, **kw)
+    with pytest.raises(ValueError):       # window must divide pn
+        pallas_fused_gnn_tick_dma(
+            p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+            *_h_pair(pn), rel_offsets=offs, node_block=96, **kw)
+    with pytest.raises(ValueError):       # unknown quant tier
+        pallas_fused_gnn_tick_dma(
+            p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints),
+            *_h_pair(pn), rel_offsets=offs, node_block=64,
+            feat_quant="fp8", **kw)
+    with pytest.raises(ValueError):       # int8 needs its scale
+        pallas_fused_gnn_tick_dma(
+            p, jnp.asarray(features).astype(jnp.int8), *_fresh(mirrors),
+            jnp.asarray(ints), *_h_pair(pn), rel_offsets=offs,
+            node_block=64, feat_quant="int8",
+            fq_rows=jnp.zeros((kw["pk"], DIM), jnp.int8), **kw)
+
+
+def test_modeled_dma_traffic_within_1p25x_of_closed_form_floor():
+    """The cost walker's dma_start pricing must track the closed-form
+    tile-traffic floor — the same bound the bench record pins at the
+    500k-pod shape, checked here at hermetic scale so drift fails
+    tier-1, not just the nightly record."""
+    p, features, mirrors, ints, offs, kw = _random_tick_operands(1)
+    pn = features.shape[0]
+    h = _h_pair(pn)
+
+    def fn(p, feats, *rest):
+        return pallas_fused_gnn_tick_dma(
+            p, feats, *rest[:7], *h, rel_offsets=offs, node_block=64, **kw)
+
+    cost = cost_jaxpr("dma", jax.make_jaxpr(fn)(
+        p, jnp.asarray(features), *_fresh(mirrors), jnp.asarray(ints)))
+    floor = dma_tick_traffic_floor(
+        pn=pn, pe=offs[-1], dim=DIM, hidden=16, num_layers=3,
+        pk=kw["pk"], ek=kw["ek"], pi=kw["pi"])
+    assert floor <= cost.hbm_bytes <= 1.25 * floor, (cost.hbm_bytes, floor)
+
+
+# -- dispatcher level -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def shipped_params():
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+        _shipped_checkpoint)
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import load_checkpoint
+    return load_checkpoint(_shipped_checkpoint())["params"]
+
+
+@pytest.fixture(scope="module")
+def dma_world(shipped_params):
+    """One churned world served through the DMA tier (budget forced to
+    1 byte so the auto-select path, not the quant override, engages)."""
+    settings = load_settings(**_BUCKETS, gnn_tick_dma=True,
+                             vmem_budget_bytes=1, gnn_dma_node_block=64)
+    cluster, builder, _ = _world(settings=settings)
+    sc = GnnStreamingScorer(builder.store, settings,
+                            params=shipped_params)
+    sc.rescore()
+    evs = list(churn_events(cluster, 40, seed=5,
+                            incident_ids=tuple(builder.store.incident_ids())))
+    for i, ev in enumerate(evs):
+        stream_step(cluster, builder.store, sc, ev)
+        if (i + 1) % 20 == 0:
+            sc.rescore()
+    return cluster, builder, sc
+
+
+def _live_args(sc):
+    ints, pk, ek = sc._packed_gnn_delta(list(sc._pending_feat.keys()))
+    args = (sc._params, sc._features_dev,
+            jnp.array(sc._kind_dev), jnp.array(sc._nmask_dev),
+            jnp.array(sc._esrc_dev), jnp.array(sc._edst_dev),
+            jnp.array(sc._erel_dev), jnp.array(sc._emask_dev),
+            jnp.asarray(ints))
+    return args, pk, ek
+
+
+def test_dispatcher_auto_selects_dma_past_vmem_budget(dma_world):
+    """Serving crossed the VMEM budget -> the scope entry must resolve
+    to the DMA variant and the dispatched tick must stay bit-identical
+    to the composed oracle ON THE SAME live state."""
+    _, _, sc = dma_world
+    assert sc._scope_entry == "streaming.gnn_tick.dma"
+    pi = sc.snapshot.padded_incidents
+    args, pk, ek = _live_args(sc)
+    dma = sc._dispatch_dma(args, pk, ek, pi, sc._rel_offsets, live=False)
+    args2, _, _ = _live_args(sc)
+    oracle = _gnn_tick(*args2, pk=pk, ek=ek, pi=pi,
+                       rel_offsets=sc._rel_offsets, slices_sorted=False,
+                       compute_dtype=None, pallas=True)
+    for name, x, y in zip(_OUT_NAMES, oracle, dma):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), name
+
+
+def test_tick_entrypoint_resolves_the_dispatched_variant(dma_world,
+                                                         shipped_params):
+    """scope._Roofline models whatever variant serving DISPATCHES —
+    the entry name must track the tier, not assume the fused path."""
+    _, builder, sc = dma_world
+    pi = sc.snapshot.padded_incidents
+    args, pk, ek = _live_args(sc)
+    assert sc._tick_entrypoint(args, pk, ek, pi) == "streaming.gnn_tick.dma"
+    expect = {
+        "": "streaming.gnn_tick.dma",
+        "bfloat16": "streaming.gnn_tick.dma.bf16",
+        "int8": "streaming.gnn_tick.dma.int8",
+    }
+    for quant, entry in expect.items():
+        settings = load_settings(**_BUCKETS, gnn_tick_dma=True,
+                                 vmem_budget_bytes=1,
+                                 gnn_dma_node_block=64,
+                                 gnn_feature_quant=quant)
+        s2 = GnnStreamingScorer(builder.store, settings,
+                                params=shipped_params)
+        a2, pk2, ek2 = _live_args(s2)
+        assert s2._tick_entrypoint(
+            a2, pk2, ek2, s2.snapshot.padded_incidents) == entry
+    s3 = GnnStreamingScorer(builder.store, load_settings(**_BUCKETS),
+                            params=shipped_params)
+    a3, pk3, ek3 = _live_args(s3)
+    assert s3._tick_entrypoint(
+        a3, pk3, ek3, s3.snapshot.padded_incidents) \
+        == "streaming.gnn_tick.bucketed"
+
+
+def test_warm_precompiles_the_exact_dma_variant(dma_world, monkeypatch):
+    """warm_gnn/warm_growth must compile the executable serving will
+    dispatch: after warm, a live churned tick through the DMA tier adds
+    ZERO compiles and ZERO new static keys."""
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn_streaming
+
+    cluster, builder, sc = dma_world
+    real = gnn_streaming._gnn_dma_tick
+    counter = CompileCounter(real)
+
+    def wrapped(*a, **kw):
+        counter.record(**kw)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(gnn_streaming, "_gnn_dma_tick", wrapped)
+    sc.warm_gnn(delta_sizes=(64,), edge_sizes=(64,))
+    warm_keys = set(counter.keys_seen)
+    warm_compiles = counter.compiles
+    assert warm_keys, "warm never exercised the DMA tier"
+    evs = list(churn_events(cluster, 8, seed=11,
+                            incident_ids=tuple(builder.store.incident_ids())))
+    for ev in evs:
+        stream_step(cluster, builder.store, sc, ev)
+    sc.dispatch()
+    live_keys = set(counter.keys_seen) - warm_keys
+    assert not live_keys, f"live tick minted un-warmed keys: {live_keys}"
+    assert counter.compiles == warm_compiles, counter.summary()

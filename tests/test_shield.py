@@ -554,6 +554,54 @@ def test_gnn_fused_kernel_fallback_degrades_to_composed(gnn_params):
     assert np.isfinite(np.asarray(out["probs"])).all()
 
 
+def test_gnn_dma_tick_device_loss_recovers_bit_identical(gnn_params):
+    """graft-tide: the beyond-VMEM DMA tick under the same device-loss
+    chaos bar as the resident tiers. The DMA tier carries extra
+    device-resident state the composed tiers don't (the persistent
+    donated h scratch pair) — recovery must rebuild it and reproduce
+    the unfaulted DMA replay bit-identically, which must itself
+    bit-match the composed baseline (streaming through VMEM windows
+    changes the lowering, never the verdicts)."""
+    cfg = dict(gnn_tick_dma=True, vmem_budget_bytes=1,
+               gnn_dma_node_block=64)
+    base, bshield, binj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert bshield.recoveries == 0
+    assert bshield.scorer._use_dma, "premise: DMA tier not configured"
+    assert bshield.scorer._scope_entry == "streaming.gnn_tick.dma", \
+        "premise: serving never dispatched the DMA variant"
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss")],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, **cfg))
+    assert shield.recoveries >= 1
+    _assert_bit_parity(out, base, injected, binj)
+    assert np.isfinite(np.asarray(out["probs"])).all()
+    composed, cshield, cinj = _run_churn(
+        2, scorer_factory=_gnn_factory(gnn_params), events=60)
+    _assert_bit_parity(base, composed, binj, cinj)
+
+
+def test_gnn_dma_kernel_fallback_walks_dma_fused_composed(gnn_params):
+    """graft-tide: the kernel_fallback rung learns the dma→fused→
+    composed ladder — persistent device faults strip ``_use_dma``
+    FIRST (back onto the resident fused tick, bit-identical), then
+    ``_use_fused``, while serving continues finite."""
+    t0 = obs_metrics.SHIELD_TIER_TRANSITIONS.value(tier="kernel_fallback")
+    out, shield, injected = _run_churn(
+        2, faults=[Fault("execute", at=1, kind="device_loss", repeats=3)],
+        scorer_factory=_gnn_factory(gnn_params), events=60,
+        settings=_settings(2, gnn_tick_dma=True, vmem_budget_bytes=1,
+                           gnn_dma_node_block=64, gnn_fused_tick=True))
+    assert shield.scorer._use_dma is False, \
+        "kernel_fallback did not strip the DMA tier first"
+    assert obs_metrics.SHIELD_TIER_TRANSITIONS.value(
+        tier="kernel_fallback") > t0
+    assert len(out["incident_ids"]) > 0
+    assert np.isfinite(np.asarray(out["probs"])).all()
+
+
 def test_persistent_gnn_fault_walks_ladder_to_rules_fallback(gnn_params):
     """Every tier fails under a persistent device fault until the GNN
     scorer is shed for the rules scorer — degraded, finite, and still
