@@ -1715,6 +1715,10 @@ def bench_serving_mesh_heal(num_pods: int = 1000, num_incidents: int = 30,
         "heals": shield_a.heals,
         "num_pods": num_pods,
         "events": events,
+        # real-TPU-only measurement, deferred to a real multi-chip run:
+        # on virtual CPU devices "losing a device" frees no ICI link and
+        # no HBM, so an end-to-end dead-device MTTR here would lie
+        "measured_dead_device_mttr_ms": None,
         "platform": jax.default_backend(),
     }
 
@@ -2896,6 +2900,7 @@ def _dma_tick_ab_record() -> None:
             "logits_bit_identical": logits_bit_identical,
             "bf16_table_parity_max_abs": bf16_parity,
             "anchors": dict(anchors),
+            "platform": jax.default_backend(),
         }
         if interpret:
             rec.update(

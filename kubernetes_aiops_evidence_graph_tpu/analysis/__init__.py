@@ -27,8 +27,19 @@ Three passes:
   waiver pragma so intentional sites are explicit and counted.
 * **Pass 3 — runtime guards** (`runtime_guards`): pytest-side transfer
   guards + a compilation counter for recompilation-hazard detection on
-  the streaming-churn workload (see tests/test_graft_audit.py).
-* **Pass 4 — graft-cost** (`cost_model`, `comms`, `baseline`): the
+  the streaming-churn workload (see tests/test_graft_audit.py), plus the
+  opt-in :class:`~.runtime_guards.LockOrderGuard` (env
+  ``KAEG_LOCK_ORDER_GUARD=1``) that records lock-acquisition order under
+  the chaos suites and fails on an observed ordering cycle.
+* **Pass 4 — graft-sentinel** (`sentinel`, `donation`, `locks`,
+  `ordering`, `dma_check`): concurrency & durability — use-after-donate
+  dataflow over the hot dirs, the GUARDED_BY lock-discipline registry +
+  static acquisition order, WAL/ledger write-ahead-of-mutation dominance
+  (shield.py / remediation), the Pallas DMA start/wait + aliasing
+  protocol, and the waiver-hygiene gate (every ``allow[...]`` pragma
+  must carry a reason). Stdlib-only, so ``scripts/audit-fast.sh`` (AST +
+  sentinel, no tracing) stays a seconds-scale pre-push loop.
+* **graft-cost** (`cost_model`, `comms`, `baseline`, ``--cost``): the
   QUANTITATIVE dimension — a static roofline model per entrypoint
   (per-primitive FLOPs, HBM read/write bytes from operand/result avals,
   peak live-intermediate bytes, arithmetic intensity), a collective
@@ -52,13 +63,13 @@ __all__ = ["Finding", "Report", "run_audit"]
 
 
 def run_audit(root=None, jaxpr: bool = True, ast: bool = True,
-              cost: bool = False) -> Report:
+              cost: bool = False, sentinel: bool = True) -> Report:
     """Run the static passes and return a combined Report.
 
-    ``root`` overrides the source tree for the AST pass (fixture trees in
-    tests); the jaxpr pass always audits the installed package's
-    registered entrypoints. ``cost=True`` adds the graft-cost pass
-    against the committed COST_BASELINE.json.
+    ``root`` overrides the source tree for the AST and sentinel passes
+    (fixture trees in tests); the jaxpr pass always audits the installed
+    package's registered entrypoints. ``cost=True`` adds the graft-cost
+    pass against the committed COST_BASELINE.json.
     """
     report = Report()
     if jaxpr:
@@ -67,6 +78,9 @@ def run_audit(root=None, jaxpr: bool = True, ast: bool = True,
     if ast:
         from .ast_lint import lint_tree
         report.extend(lint_tree(root))
+    if sentinel:
+        from .sentinel import run_sentinel
+        report.extend(run_sentinel(root))
     if cost:
         from .baseline import run_cost_pass
         findings, section = run_cost_pass()

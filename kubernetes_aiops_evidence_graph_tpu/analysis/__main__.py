@@ -17,6 +17,14 @@ committed ``COST_BASELINE.json`` (+2% FLOPs / +5% bytes tolerance; see
 analysis/baseline.py). ``--update-baseline`` re-records the baseline
 instead of ratcheting — commit the JSON diff for review.
 
+Pass 4 (graft-sentinel, stdlib-only, on by default) adds the
+concurrency & durability rules: use-after-donate dataflow, the
+GUARDED_BY lock discipline + acquisition order, WAL/ledger
+write-ahead-of-mutation dominance, and the Pallas DMA protocol
+(see analysis/sentinel.py). ``--skip-sentinel`` disables it;
+``--waivers`` lists every waiver pragma with its reason (a reason-less
+waiver is a hard failure — the hygiene gate).
+
 ``--jaxpr-fixture dotted.module`` audits a module exposing an
 ``ENTRYPOINTS`` tuple instead of the built-in registry — how the
 seeded-violation fixtures under tests/fixtures/audit are driven (with
@@ -56,6 +64,14 @@ def main(argv: "list[str] | None" = None) -> int:
                          "instead of the built-in registry")
     ap.add_argument("--skip-jaxpr", action="store_true")
     ap.add_argument("--skip-ast", action="store_true")
+    ap.add_argument("--skip-sentinel", action="store_true",
+                    help="skip pass 4 (concurrency & durability: "
+                         "use-after-donate, lock/WAL discipline, DMA "
+                         "protocol)")
+    ap.add_argument("--waivers", action="store_true",
+                    help="list every `# graft-audit: allow[rule]` pragma "
+                         "with its location, rules, and reason, then "
+                         "exit (non-zero if any waiver has no reason)")
     ap.add_argument("--cost", action="store_true",
                     help="run the graft-cost pass (static roofline + "
                          "collective census, ratcheted against "
@@ -68,6 +84,24 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
     if args.update_baseline:
         args.cost = True
+
+    if args.waivers:
+        import json as _json
+
+        from .sentinel import collect_waivers
+        entries = collect_waivers(args.root)
+        bare = [e for e in entries if not e["reason"]]
+        if args.report == "json":
+            print(_json.dumps({"waivers": entries,
+                               "missing_reason": len(bare)}, indent=2))
+        else:
+            for e in entries:
+                flag = "" if e["reason"] else "  <-- MISSING REASON"
+                print(f"{e['where']} [{', '.join(e['rules'])}] "
+                      f"{e['reason']}{flag}")
+            print(f"graft-audit: {len(entries)} waiver(s), "
+                  f"{len(bare)} missing a reason")
+        return 1 if bare else 0
 
     from .findings import Report
     report = Report()
@@ -91,6 +125,9 @@ def main(argv: "list[str] | None" = None) -> int:
     if not args.skip_ast:
         from .ast_lint import lint_tree
         report.extend(lint_tree(args.root))
+    if not args.skip_sentinel:
+        from .sentinel import run_sentinel
+        report.extend(run_sentinel(args.root))
     if args.cost:
         from .baseline import run_cost_pass
         findings, section = run_cost_pass(

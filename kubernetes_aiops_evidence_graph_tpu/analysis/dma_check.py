@@ -1,0 +1,217 @@
+"""graft-sentinel rule family 4 — the Pallas DMA protocol.
+
+The graft-tide streaming kernels overlap HBM->VMEM copies with compute
+via ``pltpu.make_async_copy(src, dst, sem).start()`` / ``.wait()`` and
+ping-pong VMEM buffers. Three protocol properties are checkable from
+the kernel AST:
+
+* ``dma-start-no-wait`` / ``dma-wait-no-start`` — every semaphore that
+  is started must also be awaited somewhere in the same kernel (and
+  vice versa). An un-awaited start races the copy against the compute
+  that reads the destination; an un-started wait deadlocks the grid.
+  Matching is kernel-wide and keyed by the semaphore expression with
+  subscripts stripped (``sem_e.at[s]`` and ``sem_e.at[slot]`` both key
+  as ``sem_e.at`` — per-slot pairing happens through helper functions,
+  which a lexical checker pools rather than path-splits).
+* ``dma-double-buffer`` — two-plus starts into the SAME
+  constant-indexed destination slot (``bufs[0]`` twice) means the
+  ping-pong alternation was lost: the second copy lands on a buffer the
+  compute may still be reading. Alternating patterns index with a
+  loop-parity expression (``bufs[li % 2]``) and never trip this.
+* ``dma-alias`` — every ``pallas_call`` carrying
+  ``input_output_aliases`` must be registered in
+  :data:`DMA_ALIAS_SITES`: either as ``"scratch"`` (the aliased input
+  is a trace-local accumulator, no donation contract) or as the
+  ``(rel, fn)`` of the jit wrapper whose ``donate_argnums`` feeds the
+  aliased operands — that wrapper must exist in
+  :data:`~.ast_lint.JIT_DECLARATIONS` with a non-empty donate tuple.
+  Aliasing a non-donated caller buffer is how "XLA wrote the output
+  over an input the caller still holds" bugs are born.
+
+Kernel discovery reuses the pass-2 idiom: a function name passed as the
+first argument to ``pl.pallas_call``/``pltpu.pallas_call``. Fixture
+trees register alias sites inline via ``GRAFT_SENTINEL["dma_alias"]``
+(``{"fn": "scratch"}`` or ``{"fn": ["self", "wrapper"]}`` for a
+module-local donating wrapper).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .ast_lint import (JIT_DECLARATIONS, _call_name, _jit_decoration,
+                       _dotted)
+
+# (rel path, enclosing function of the pallas_call) -> "scratch" | the
+# (rel, fn) JIT_DECLARATIONS key of the donating wrapper the aliased
+# operands flow through
+DMA_ALIAS_SITES: dict[tuple[str, str], "str | tuple[str, str]"] = {
+    # out-accumulator init buffers created inside the trace — aliasing
+    # avoids the zero-init branch in the kernel, no caller donation
+    ("ops/pallas_segment.py", "_gms_forward"): "scratch",
+    ("ops/pallas_segment.py", "_gms_grad_w"): "scratch",
+    # the fused/DMA ticks alias the resident mirror through the kernel;
+    # the donation contract lives on the gnn_streaming jit wrappers
+    ("ops/pallas_segment.py", "_fused_forward"):
+        ("rca/gnn_streaming.py", "_gnn_fused_tick"),
+    ("ops/pallas_segment.py", "_dma_forward"):
+        ("rca/gnn_streaming.py", "_gnn_dma_tick"),
+}
+
+_PALLAS_CALL = ("pl.pallas_call", "pallas_call", "pltpu.pallas_call")
+_MAKE_COPY = ("pltpu.make_async_copy", "make_async_copy",
+              "pl.make_async_copy")
+_SUBSCRIPT = re.compile(r"\[[^][]*\]")
+_CONST_SLOT = re.compile(r"\[\d+\]")
+
+
+def _sem_key(expr) -> str:
+    """Semaphore expression with subscripts stripped."""
+    return _SUBSCRIPT.sub("", ast.unparse(expr))
+
+
+def _copy_args(call: ast.Call) -> "tuple | None":
+    """(dst_expr, sem_expr) if the call is make_async_copy(src, dst, sem)."""
+    if _call_name(call) in _MAKE_COPY and len(call.args) >= 3:
+        return call.args[1], call.args[2]
+    return None
+
+
+class _KernelScan:
+    """Pool every start/wait in one kernel body (nested helpers
+    included — tile_start/tile_wait pairing crosses them)."""
+
+    def __init__(self, sf, fn: ast.FunctionDef):
+        self.sf, self.fn = sf, fn
+        # name -> (dst_expr, sem_expr) for `cp = make_async_copy(...)`
+        assigned: dict[str, tuple] = {}
+        self.starts: list[tuple] = []   # (line, sem key, dst unparse)
+        self.waits: list[tuple] = []    # (line, sem key)
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                pair = _copy_args(node.value)
+                if pair is not None:
+                    assigned[node.targets[0].id] = pair
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("start", "wait")):
+                continue
+            recv = node.func.value
+            pair = _copy_args(recv) if isinstance(recv, ast.Call) else \
+                assigned.get(recv.id) if isinstance(recv, ast.Name) else None
+            if pair is None:
+                continue
+            dst, sem = pair
+            if node.func.attr == "start":
+                self.starts.append((node.lineno, _sem_key(sem),
+                                    ast.unparse(dst)))
+            else:
+                self.waits.append((node.lineno, _sem_key(sem)))
+
+    def run(self) -> None:
+        started = {k for _l, k, _d in self.starts}
+        waited = {k for _l, k in self.waits}
+        for line, key, _dst in sorted(self.starts):
+            if key not in waited:
+                self.sf.hit(
+                    "dma-start-no-wait", line,
+                    f"async copy started on semaphore '{key}' in kernel "
+                    f"'{self.fn.name}' with no matching .wait() anywhere "
+                    "in the kernel — the compute races the in-flight "
+                    "copy into its destination")
+                break   # one finding per kernel keeps the report readable
+        for line, key in sorted(self.waits):
+            if key not in started:
+                self.sf.hit(
+                    "dma-wait-no-start", line,
+                    f".wait() on semaphore '{key}' in kernel "
+                    f"'{self.fn.name}' with no matching .start() — the "
+                    "grid deadlocks on a semaphore nothing signals")
+                break
+        slots: dict[str, int] = {}
+        for line, _key, dst in sorted(self.starts):
+            if not _CONST_SLOT.search(dst):
+                continue            # parity-indexed ping-pong: fine
+            if dst in slots:
+                self.sf.hit(
+                    "dma-double-buffer", line,
+                    f"second DMA start into constant slot '{dst}' (first "
+                    f"at line {slots[dst]}) in kernel '{self.fn.name}' — "
+                    "double-buffering requires alternating slots "
+                    "(index by loop parity), or the copy lands on a "
+                    "buffer the compute still reads")
+            slots.setdefault(dst, line)
+
+
+def _local_donating_wrappers(sf) -> set[str]:
+    out = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            dec = _jit_decoration(node)
+            if dec is not None and dec[1]:
+                out.add(node.name)
+    return out
+
+
+def _check_alias_sites(sf) -> None:
+    inline = sf.inline.get("dma_alias", {})
+    # map each pallas_call to its enclosing function by a scoped walk
+    def scan(node, fname: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            nf = child.name if isinstance(child, ast.FunctionDef) else fname
+            if isinstance(child, ast.Call) \
+                    and _call_name(child) in _PALLAS_CALL \
+                    and any(kw.arg == "input_output_aliases"
+                            for kw in child.keywords):
+                decl = DMA_ALIAS_SITES.get((sf.rel, fname),
+                                           inline.get(fname))
+                if decl is None:
+                    sf.hit(
+                        "dma-alias", child.lineno,
+                        f"pallas_call with input_output_aliases in "
+                        f"'{fname}' is not registered in "
+                        "DMA_ALIAS_SITES — declare the aliased operands "
+                        "as trace-local scratch or name the donating jit "
+                        "wrapper they flow through")
+                elif decl != "scratch":
+                    wrapper_rel, wrapper_fn = tuple(decl)
+                    if wrapper_rel == "self":
+                        ok = wrapper_fn in _local_donating_wrappers(sf)
+                    else:
+                        declared = JIT_DECLARATIONS.get(
+                            (wrapper_rel, wrapper_fn))
+                        ok = bool(declared and declared[1])
+                    if not ok:
+                        sf.hit(
+                            "dma-alias", child.lineno,
+                            f"alias site '{fname}' names wrapper "
+                            f"{(wrapper_rel, wrapper_fn)} but that jit "
+                            "site has no (non-empty) donate_argnums — "
+                            "aliasing a non-donated caller buffer lets "
+                            "XLA overwrite an input the caller still "
+                            "holds")
+            scan(child, nf)
+    scan(sf.tree, "<module>")
+
+
+def check(sf) -> None:
+    if not sf.in_hot:
+        return
+    kernel_names = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _call_name(node) in _PALLAS_CALL:
+            if node.args and isinstance(node.args[0], ast.Name):
+                kernel_names.add(node.args[0].id)
+            elif node.args:
+                inner = _dotted(node.args[0])
+                if inner:
+                    kernel_names.add(inner.rsplit(".", 1)[-1])
+    if kernel_names:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef) \
+                    and node.name in kernel_names:
+                _KernelScan(sf, node).run()
+    _check_alias_sites(sf)

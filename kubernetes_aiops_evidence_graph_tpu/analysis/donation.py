@@ -1,0 +1,182 @@
+"""graft-sentinel rule family 1 — ``use-after-donate``.
+
+A jitted call with ``donate_argnums`` hands the listed operand buffers to
+XLA: after the call returns, those buffers may already hold the outputs
+(the whole point of the resident-mirror tick discipline — zero
+reallocation per dispatch). Reading, returning, or storing a donated
+value afterwards is therefore a use-after-free in device memory; on CPU
+it silently aliases, on TPU it is garbage. The sanctioned patterns are
+(a) rebind the name from the call's outputs, or (b) pass a fresh
+stand-in per call (see ``StreamingScorer.warm``).
+
+The checker is an intraprocedural, flow-sensitive taint walk over every
+function in the hot dirs:
+
+* a call whose (trailing) name resolves to a donating callable taints
+  each plain-``Name`` argument in a donated position;
+* any later read of a tainted name — including inside a ``return`` or on
+  the right-hand side of a store — on ANY path is a finding;
+* reassignment clears the taint (fresh value, fresh buffer);
+* branches fork the state and merge by union (tainted on any path is
+  tainted), loop bodies run twice so a taint minted in iteration N is
+  seen by the loop head in iteration N+1.
+
+Donating callables come from two sources, both keyed to THIS file:
+:data:`~.ast_lint.JIT_DECLARATIONS` entries for the file's relative path
+with a non-empty donate tuple, and module-local jit sites (decorated
+defs and ``name = jax.jit(fn, donate_argnums=...)`` assignments) — so
+fixture trees exercise the rule without touching the central registry.
+
+Scope limits (documented, deliberate): nested function definitions are
+not descended into (closures over donated names are defined before the
+donating call in every hot module), and only plain-``Name`` arguments
+taint — attribute chains like ``self._features_dev`` are resident-state
+handles whose rebinding the lock/tick discipline already owns.
+"""
+from __future__ import annotations
+
+import ast
+
+from .ast_lint import (JIT_DECLARATIONS, _call_name, _jit_decoration,
+                       _static_argnames_from_call)
+
+
+def _donating_callables(sf) -> dict[str, tuple[int, ...]]:
+    donors: dict[str, tuple[int, ...]] = {}
+    for (rel, fname), (_statics, donate) in JIT_DECLARATIONS.items():
+        if rel == sf.rel and donate:
+            donors[fname] = tuple(donate)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            dec = _jit_decoration(node)
+            if dec is not None and dec[1]:
+                donors[node.name] = tuple(dec[1])
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _call_name(node.value) in ("jax.jit", "jit")):
+            _statics, donate = _static_argnames_from_call(node.value)
+            if donate:
+                donors[node.targets[0].id] = tuple(donate)
+    return donors
+
+
+class _Taint:
+    """One function's walk. ``state`` maps name -> (donor line, callee,
+    donated position)."""
+
+    def __init__(self, sf, donors: dict[str, tuple[int, ...]]):
+        self.sf, self.donors = sf, donors
+        self.seen: set[tuple[int, str]] = set()
+
+    # -- statement execution ---------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        self.block(fn.body, {})
+
+    def block(self, stmts, state: dict) -> dict:
+        for stmt in stmts:
+            state = self.stmt(stmt, state)
+        return state
+
+    def stmt(self, stmt, state: dict) -> dict:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return state                       # scope limit: not descended
+        if isinstance(stmt, ast.If):
+            out_b = self.block(stmt.body, dict(state))
+            out_e = self.block(stmt.orelse, dict(state))
+            return {**out_e, **out_b}          # union: tainted on any path
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                state = self.simple(stmt, state, reads_only=True)
+            once = self.block(stmt.body, dict(state))
+            twice = self.block(stmt.body, {**state, **once})
+            merged = {**state, **twice}
+            return self.block(stmt.orelse, merged)
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.check_reads(item.context_expr, state)
+            state = self.kill_targets(stmt, state)
+            return self.block(stmt.body, state)
+        if isinstance(stmt, ast.Try):
+            out_b = self.block(stmt.body, dict(state))
+            merged = {**state, **out_b}
+            for h in stmt.handlers:
+                merged = {**merged, **self.block(h.body, dict(merged))}
+            merged = self.block(stmt.orelse, merged)
+            return self.block(stmt.finalbody, merged)
+        return self.simple(stmt, state)
+
+    def simple(self, stmt, state: dict, reads_only: bool = False) -> dict:
+        self.check_reads(stmt, state)
+        if reads_only:
+            return state
+        new = dict(state)
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = _call_name(call).rsplit(".", 1)[-1]
+            donate = self.donors.get(callee)
+            if not donate:
+                continue
+            for pos in donate:
+                if pos < len(call.args) and isinstance(call.args[pos],
+                                                       ast.Name):
+                    new[call.args[pos].id] = (call.lineno, callee, pos)
+        return self.kill_targets(stmt, new)
+
+    # -- helpers ----------------------------------------------------------
+
+    def check_reads(self, node, state: dict) -> None:
+        if not state:
+            return
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in state and (n.lineno, n.id) not in self.seen):
+                dline, callee, pos = state[n.id]
+                self.seen.add((n.lineno, n.id))
+                self.sf.hit(
+                    "use-after-donate", n.lineno,
+                    f"'{n.id}' was passed in donated position {pos} of "
+                    f"'{callee}' (line {dline}) and is read here — a "
+                    "donated buffer is invalidated by XLA; rebind the "
+                    "name from the call's outputs or pass a fresh "
+                    "stand-in per call")
+
+    @staticmethod
+    def kill_targets(stmt, state: dict) -> dict:
+        killed = set()
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.With):
+            targets = [i.optional_vars for i in stmt.items
+                       if i.optional_vars is not None]
+        else:
+            targets = []
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    killed.add(n.id)
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.NamedExpr) and isinstance(n.target,
+                                                           ast.Name):
+                killed.add(n.target.id)
+        if not killed:
+            return state
+        return {k: v for k, v in state.items() if k not in killed}
+
+
+def check(sf) -> None:
+    if not sf.in_hot:
+        return
+    donors = _donating_callables(sf)
+    if not donors:
+        return
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            _Taint(sf, donors).run(node)

@@ -10,13 +10,61 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass, field
 
+# Canonical rule-id registry: id -> (pass name, one-line description).
+# EVERY Finding(rule=...) literal in analysis/ must resolve here (the
+# drift guard in tests/test_graft_sentinel.py fails on a typo'd or
+# undocumented id), and ``--report json`` embeds the table so the CI
+# artifact is self-describing.
+RULES: dict[str, tuple[str, str]] = {
+    # pass 1 — jaxpr audit
+    "trace-error": ("jaxpr", "entrypoint failed to trace at its canonical shapes"),
+    "no-f64": ("jaxpr", "float64 intermediate in a hot-path jaxpr"),
+    "forbidden-primitive": ("jaxpr", "primitive on the entrypoint's forbidden list"),
+    "no-2d-scatter": ("jaxpr", "2-D scatter in the GNN hot path (PR 1 regression class)"),
+    "byte-budget": ("jaxpr", "intermediate exceeds the per-entrypoint byte budget ([N,R,H]-scale materialization)"),
+    "bf16-accum": ("jaxpr", "matmul accumulates in bf16 instead of f32"),
+    "sorted-scatter-lost": ("jaxpr", "sorted-scatter contract lost (segment layout no longer (rel,dst)-sorted)"),
+    # pass 2 — AST lint
+    "syntax-error": ("ast", "file failed to parse"),
+    "tracer-branch": ("ast", "Python branch on a traced value inside jitted code"),
+    "np-in-traced": ("ast", "np.* call inside traced code (host per trace, constant-folds device data)"),
+    "wall-clock": ("ast", "time.time() used for durations (non-monotonic under NTP steps)"),
+    "host-sync": ("ast", "implicit device->host sync in a hot module"),
+    "broad-except": ("ast", "broad except swallows all errors"),
+    "recovery-no-broad-except": ("ast", "broad except in a recovery function that neither re-raises nor escalates"),
+    "missing-static": ("ast", "int/bool-annotated jitted parameter not in static_argnames"),
+    "jit-undeclared": ("ast", "hot-dir jit site missing from JIT_DECLARATIONS"),
+    "jit-signature": ("ast", "jit site static/donate signature drifted from JIT_DECLARATIONS"),
+    "tick-donation": ("ast", "resident-state tick entrypoint donates no buffers"),
+    # pass 4 — graft-sentinel (concurrency & durability)
+    "use-after-donate": ("sentinel", "value read/returned/stored after being passed in a donated position"),
+    "lock-guard": ("sentinel", "GUARDED_BY attribute accessed outside a `with <lock>` scope"),
+    "lock-order": ("sentinel", "nested lock acquisition violates the declared acquisition order"),
+    "wal-order": ("sentinel", "resident-state mutation reachable before its WAL journal append"),
+    "ledger-order": ("sentinel", "cluster mutation reachable before its intent-ledger row"),
+    "dma-start-no-wait": ("sentinel", "async-copy start with no matching wait on the same semaphore"),
+    "dma-wait-no-start": ("sentinel", "async-copy wait with no matching start on the same semaphore"),
+    "dma-double-buffer": ("sentinel", "multiple DMA starts into one constant-indexed buffer slot (ping-pong lost)"),
+    "dma-alias": ("sentinel", "aliased pallas_call site unregistered or its jit wrapper donates nothing"),
+    "waiver-no-reason": ("sentinel", "# graft-audit: allow[...] pragma with no reason text"),
+    # cost pass — graft-cost ratchet
+    "cost-flops": ("cost", "modeled FLOPs regressed beyond the +2% ratchet"),
+    "cost-bytes": ("cost", "modeled HBM/peak-intermediate bytes regressed beyond the +5% ratchet"),
+    "cost-collective-bytes": ("cost", "modeled collective payload regressed beyond the +5% ratchet"),
+    "cost-baseline-missing": ("cost", "entrypoint has no committed baseline row"),
+    "cost-baseline-stale": ("cost", "baseline row for an entrypoint that no longer exists"),
+    "forbidden-collective": ("cost", "collective primitive on the entrypoint's forbidden list"),
+    "collective-count": ("cost", "more collectives per tick than the CostSpec permits"),
+    "collective-bytes": ("cost", "collective payload exceeds the CostSpec ceiling"),
+}
+
 
 @dataclass(frozen=True)
 class Finding:
-    rule: str            # e.g. "forbidden-primitive", "broad-except"
+    rule: str            # a key of RULES, e.g. "forbidden-primitive"
     where: str           # "path:line" (ast) or "entrypoint-name" (jaxpr)
     message: str
-    pass_name: str       # "jaxpr" | "ast" | "runtime"
+    pass_name: str       # "jaxpr" | "ast" | "runtime" | "sentinel" | "cost"
     waived: bool = False
     waiver_reason: str = ""
 
@@ -70,6 +118,10 @@ class Report:
             "entrypoints": self.entrypoints_audited,
             "violations": [f.to_dict() for f in self.violations],
             "waived": [f.to_dict() for f in self.waivers],
+            # self-describing artifact: the canonical rule table rides
+            # along so a CI consumer can map ids without the source tree
+            "rules": {rid: {"pass": p, "description": d}
+                      for rid, (p, d) in sorted(RULES.items())},
         }
         if self.cost:
             d["cost"] = self.cost

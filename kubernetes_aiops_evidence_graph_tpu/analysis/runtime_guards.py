@@ -15,10 +15,21 @@ streaming-churn workload.
   cache growth AND the distinct static keys observed, so a test can
   assert compiles == distinct keys (no silent retrace) and that every
   key is drawn from the declared ladder.
+* :class:`LockOrderGuard` — the dynamic half of the sentinel
+  ``lock-order`` rule. Opt-in (``KAEG_LOCK_ORDER_GUARD=1``, installed by
+  the tests/conftest.py session fixture): patches the
+  ``threading.Lock``/``RLock`` factories so every lock created after
+  install is tagged with its allocation site, records the
+  site-level acquisition graph per thread, and flags any edge that
+  closes a cycle — the two-thread deadlock shape, caught from a
+  single-threaded witness. The chaos suites run under it in CI.
 """
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
+import threading
 from dataclasses import dataclass, field
 
 
@@ -88,3 +99,177 @@ def ladder_retrace_budget(delta_buckets, edge_buckets=None) -> int:
     pk = len(tuple(delta_buckets))
     ek = len(tuple(edge_buckets if edge_buckets is not None else delta_buckets))
     return pk * ek
+
+
+class _GuardedLock:
+    """Proxy around a real lock that reports acquire/release to the
+    guard. Everything else (Condition's ``_is_owned`` etc.) delegates."""
+
+    def __init__(self, guard: "LockOrderGuard", real, site: str):
+        self._guard, self._real, self._site = guard, real, site
+
+    def acquire(self, *a, **kw):
+        got = self._real.acquire(*a, **kw)
+        if got:
+            self._guard._note_acquire(self._site)
+        return got
+
+    def release(self):
+        self._guard._note_release(self._site)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+class LockOrderGuard:
+    """Dynamic lock-ordering witness (the runtime half of the static
+    sentinel ``lock-order`` rule).
+
+    Locks are classed by ALLOCATION SITE (``file.py:line`` of the
+    ``threading.Lock()`` call): every scorer's ``serve_lock`` is one
+    class, every server's ``_lock`` another. Acquiring B while holding A
+    records the site edge A→B; an acquisition whose new edge closes a
+    cycle in the site graph is the deadlock shape — two threads walking
+    the cycle in opposite directions can deadlock even if THIS run,
+    single-threaded, sailed through. That is what makes the guard useful
+    under the chaos suites: one interleaving witnesses the hazard for
+    all of them.
+
+    Test-only and opt-in: patches the ``threading.Lock``/``RLock``
+    factories, so only locks created between :meth:`install` and
+    :meth:`uninstall` are tracked. Violations collect in
+    :attr:`violations`; :meth:`assert_clean` raises on any.
+    """
+
+    ENV = "KAEG_LOCK_ORDER_GUARD"
+
+    def __init__(self):
+        self.violations: list[dict] = []
+        self._edges: set[tuple] = set()
+        self._tls = threading.local()
+        self._meta = threading.Lock()   # pre-patch factory: not tracked
+        self._saved = None
+
+    # -- factory patching ---------------------------------------------
+
+    def _site(self) -> str:
+        f = sys._getframe(2)
+        here = (__file__, threading.__file__)
+        while f is not None and f.f_code.co_filename in here:
+            f = f.f_back
+        if f is None:
+            return "<unknown>"
+        return f"{os.path.basename(f.f_code.co_filename)}:{f.f_lineno}"
+
+    def install(self) -> "LockOrderGuard":
+        if self._saved is not None:
+            return self
+        real_lock, real_rlock = threading.Lock, threading.RLock
+        self._saved = (real_lock, real_rlock)
+
+        def lock_factory():
+            return _GuardedLock(self, real_lock(), self._site())
+
+        def rlock_factory():
+            return _GuardedLock(self, real_rlock(), self._site())
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        return self
+
+    def uninstall(self) -> None:
+        if self._saved is not None:
+            threading.Lock, threading.RLock = self._saved
+            self._saved = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- acquisition bookkeeping --------------------------------------
+
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _note_acquire(self, site: str) -> None:
+        held = self._held()
+        with self._meta:
+            for h in held:
+                if h == site:   # re-entrant same-class: not an ordering
+                    continue
+                if (h, site) not in self._edges and \
+                        self._reaches(site, h):
+                    self.violations.append({
+                        "cycle": (h, site),
+                        "thread": threading.current_thread().name,
+                        "path": self._path(site, h),
+                    })
+                self._edges.add((h, site))
+        held.append(site)
+
+    def _note_release(self, site: str) -> None:
+        held = self._held()
+        if site in held:
+            # remove the innermost matching frame (locks are released
+            # LIFO in `with` blocks; tolerate hand-rolled ordering)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == site:
+                    del held[i]
+                    break
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen, todo = set(), [src]
+        while todo:
+            cur = todo.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            todo.extend(b for a, b in self._edges if a == cur)
+        return False
+
+    def _path(self, src: str, dst: str) -> list:
+        """One witness path src→dst through the recorded edges."""
+        parents, todo = {src: None}, [src]
+        while todo:
+            cur = todo.pop(0)
+            if cur == dst:
+                out = [cur]
+                while parents[cur] is not None:
+                    cur = parents[cur]
+                    out.append(cur)
+                return out[::-1]
+            for a, b in self._edges:
+                if a == cur and b not in parents:
+                    parents[b] = cur
+                    todo.append(b)
+        return [src, dst]
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise AssertionError(
+                f"lock-order cycles observed: {self.violations}")
+
+
+def maybe_install_lock_order_guard() -> "LockOrderGuard | None":
+    """Session hook: install iff ``KAEG_LOCK_ORDER_GUARD=1`` (how the
+    chaos CI jobs and local chaos repros opt in)."""
+    if os.environ.get(LockOrderGuard.ENV) != "1":
+        return None
+    return LockOrderGuard().install()

@@ -1,0 +1,163 @@
+"""Pass 4 — graft-sentinel: concurrency & durability static analysis.
+
+The serving stack is a heavily concurrent, crash-consistent system —
+donated resident buffers, WAL-before-mutate shield discipline,
+intent-before-mutation remediation ledgers, swap/heal generation
+boundaries under ``serve_lock``, and double-buffered ``dma_start`` /
+``dma_wait`` Pallas streaming — and until this pass every one of those
+invariants was enforced only by convention and replay tests. This module
+is the shared driver: it parses each source file once, hands the
+:class:`SentinelFile` to the four rule-family checkers, and adds the
+waiver-hygiene gate.
+
+Rule families (each in its own module):
+
+* :mod:`.donation`  — ``use-after-donate``: intraprocedural dataflow over
+  the hot dirs; a value passed in a donated position of a jitted call
+  must not be read, returned, or stored afterwards on any path.
+* :mod:`.locks`     — ``lock-guard`` / ``lock-order``: the
+  :data:`~.locks.GUARDED_BY` registry maps resident-state attributes to
+  their lock; accesses outside a ``with <lock>`` scope fail, and nested
+  acquisitions must follow the declared order (the
+  ``surge.swap_tenants_atomically`` convention).
+* :mod:`.ordering`  — ``wal-order`` / ``ledger-order``: registered
+  mutation calls must be dominated by the matching journal-append /
+  intent-row call in the same function (WAL-before-mutate).
+* :mod:`.dma_check` — ``dma-start-no-wait`` / ``dma-wait-no-start`` /
+  ``dma-double-buffer`` / ``dma-alias``: Pallas kernel DMA protocol and
+  ``input_output_aliases``-vs-donation consistency.
+
+Plus the hygiene gate here: ``waiver-no-reason`` — every ``# graft-audit:
+allow[rule]`` pragma must carry a reason; a bare waiver is a hard
+failure (it is also the one rule that cannot itself be waived).
+
+Fixture trees (and, if ever needed, real modules) can extend the central
+registries inline with a module-level literal::
+
+    GRAFT_SENTINEL = {
+        "guarded_by": {"serve_lock": ["_params"]},
+        "held_fns": ["_swap_locked"],
+        "lock_order": ["outer_lock", "inner_lock"],
+        "ordering": {"rule": "wal-order", "journal": ["append"],
+                     "mutate": ["apply"], "exempt": "replay|recover"},
+        "dma_alias": {"fn_name": "scratch"},   # or ["rel/path.py", "fn"]
+    }
+
+This pass is stdlib-only (never imports jax) so ``scripts/audit-fast.sh``
+stays a seconds-scale pre-push loop.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from pathlib import Path
+
+from .ast_lint import _WAIVER_RE, HOT_DIRS, package_root
+from .findings import Finding, Report
+
+
+def _comment_waivers(source: str) -> dict[int, tuple[set, str]]:
+    """line -> (rules, reason) for every waiver pragma in a REAL comment
+    token — docstrings quoting the pragma syntax don't count."""
+    out: dict[int, tuple[set, str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _WAIVER_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                out[tok.start[0]] = (rules, m.group(2).strip())
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+class SentinelFile:
+    """One parsed source file shared by the four checkers."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path, self.rel, self.source = path, rel, source
+        self.tree = ast.parse(source)
+        self.findings: list[Finding] = []
+        self.in_hot = bool(set(Path(rel).parts[:-1]) & HOT_DIRS)
+        self.waivers = _comment_waivers(source)
+        self.inline = self._inline_registry()
+
+    def _inline_registry(self) -> dict:
+        """Module-level ``GRAFT_SENTINEL = {...}`` literal (fixtures)."""
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "GRAFT_SENTINEL"):
+                try:
+                    value = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return {}
+                return value if isinstance(value, dict) else {}
+        return {}
+
+    def hit(self, rule: str, line: int, message: str,
+            waivable: bool = True) -> None:
+        waived, reason = False, ""
+        if waivable:
+            for ln in (line, line - 1):
+                w = self.waivers.get(ln)
+                if w and (rule in w[0] or "all" in w[0]):
+                    waived, reason = True, w[1]
+                    break
+        self.findings.append(Finding(
+            rule=rule, where=f"{self.rel}:{line}", message=message,
+            pass_name="sentinel", waived=waived, waiver_reason=reason))
+
+
+def collect_waivers(root: "Path | str | None" = None) -> list[dict]:
+    """Every waiver pragma under ``root`` — the ``--waivers`` CLI mode."""
+    base = Path(root) if root is not None else package_root()
+    out: list[dict] = []
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        for i, (rules, reason) in sorted(
+                _comment_waivers(path.read_text()).items()):
+            out.append({"where": f"{rel}:{i}",
+                        "rules": sorted(rules),
+                        "reason": reason})
+    return out
+
+
+def _waiver_hygiene(sf: SentinelFile) -> None:
+    """``waiver-no-reason``: a bare pragma silently hides a rule with no
+    recorded justification — hard failure, never itself waivable."""
+    for line, (rules, reason) in sorted(sf.waivers.items()):
+        if not reason:
+            sf.hit("waiver-no-reason", line,
+                   f"waiver for [{', '.join(sorted(rules))}] carries no "
+                   "reason — `# graft-audit: allow[rule] why` is the "
+                   "contract; a bare allow hides the rule with no "
+                   "recorded justification", waivable=False)
+
+
+def run_sentinel(root: "Path | str | None" = None) -> Report:
+    """Run the four sentinel checkers + waiver hygiene over ``root``
+    (default: the installed package)."""
+    from . import dma_check, donation, locks, ordering
+    base = Path(root) if root is not None else package_root()
+    report = Report()
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        try:
+            sf = SentinelFile(path, rel, path.read_text())
+        except SyntaxError:
+            continue    # pass 2 already reports syntax-error
+        donation.check(sf)
+        locks.check(sf)
+        ordering.check(sf)
+        dma_check.check(sf)
+        _waiver_hygiene(sf)
+        report.findings.extend(sf.findings)
+    return report

@@ -1427,6 +1427,7 @@ class GnnStreamingScorer(StreamingScorer):
                         compute_dtype=self._compute_dtype
                         if self._use_bucketed else None,
                         use_pallas=bool(self._use_fused))
+                    # graft-audit: allow[lock-guard] warm pre-compile reads whichever generation is current; a concurrent swap at worst triggers one re-warm
                     tick(self._params, feats,
                          *self._sharded_gnn_standins(cpn, cpe),
                          jnp.asarray(ints))
@@ -1441,6 +1442,7 @@ class GnnStreamingScorer(StreamingScorer):
                     np.zeros(2 * cpi, np.int32),
                 ]).astype(np.int32, copy=False)
                 self._call_gnn_tick(
+                    # graft-audit: allow[lock-guard] warm pre-compile reads whichever generation is current; a concurrent swap at worst triggers one re-warm
                     (self._params,
                      jnp.zeros((cpn, dim), jnp.float32),
                      jnp.zeros(cpn, jnp.int32),

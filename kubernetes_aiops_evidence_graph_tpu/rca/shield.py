@@ -344,6 +344,7 @@ class ShieldedScorer:
             prev = getattr(s, "_params_prev", None)
             if prev is None:
                 return None
+            # graft-audit: allow[wal-order] rollback applies FIRST so the journal records the exact restored leaves as a fresh swap record; crash in the gap replays the pre-rollback swap, and the nonfinite backstop that triggered us re-fires
             gen = s.rollback_params()
             if gen is None:
                 return None
@@ -701,10 +702,13 @@ class ShieldedScorer:
                 s.snapshot.padded_nodes, d_old, survivors)
             seq = int(s._synced_seq)
             self._heal_gen += 1
+            heal_gen = self._heal_gen   # captured under serve_lock: the
+            # post-lock telemetry below must report THIS heal, not a
+            # concurrent one that bumped the counter after release
             self.journal.append(
                 (), seq, seq, kind="mesh_heal", force_sync=True,
                 shards=d_new, exclude=excluded, from_shards=d_old,
-                heal_gen=self._heal_gen)
+                heal_gen=heal_gen)
             mesh = heal_mod.survivor_mesh(d_new, excluded)
             s.adopt_mesh(mesh)
             self._mesh_excluded = excluded
@@ -718,7 +722,7 @@ class ShieldedScorer:
         obs_metrics.MESH_SERVING_SHARDS.set(float(max(d_new, 1)))
         obs_scope.FLIGHT_RECORDER.note_event(
             "mesh_heal", from_shards=d_old, to_shards=d_new,
-            excluded=list(excluded), heal_gen=self._heal_gen)
+            excluded=list(excluded), heal_gen=heal_gen)
         # the on-disk snapshot still carries the OLD mesh shape: force a
         # fresh one at the next generation boundary so recovery replays
         # at most one heal record
@@ -727,13 +731,14 @@ class ShieldedScorer:
                     excluded=excluded,
                     seconds=round(self.last_heal_seconds, 4))
         return {"from_shards": d_old, "shards": d_new,
-                "excluded": excluded, "heal_gen": self._heal_gen}
+                "excluded": excluded, "heal_gen": heal_gen}
 
     def _maybe_reexpand(self) -> None:
         """Half-open probe gate: once every excluded device's breaker has
         cooled down, grow D' back to the home mesh — the probe IS the
         next guarded tick. A clean pass closes the probing breakers; one
         more shard-localized failure re-opens and re-heals immediately."""
+        # graft-audit: allow[lock-guard] advisory half-open gate: reexpand() re-checks _mesh_excluded under serve_lock; a stale read only delays the probe by one tick
         if (self._mesh_excluded and self._heal_enabled()
                 and self.health.can_reexpand()):
             self.reexpand()
@@ -753,10 +758,12 @@ class ShieldedScorer:
             d_new = self._mesh_home
             seq = int(s._synced_seq)
             self._heal_gen += 1
+            heal_gen = self._heal_gen   # captured under serve_lock for
+            # the post-lock telemetry, same as mesh_heal
             self.journal.append(
                 (), seq, seq, kind="mesh_heal", force_sync=True,
                 shards=d_new, exclude=(), from_shards=d_old,
-                heal_gen=self._heal_gen, reexpand=True)
+                heal_gen=heal_gen, reexpand=True)
             mesh = heal_mod.survivor_mesh(d_new, ())
             s.adopt_mesh(mesh)
             excluded, self._mesh_excluded = self._mesh_excluded, ()
@@ -769,12 +776,12 @@ class ShieldedScorer:
         obs_metrics.MESH_SERVING_SHARDS.set(float(max(d_new, 1)))
         obs_scope.FLIGHT_RECORDER.note_event(
             "mesh_reexpand", from_shards=d_old, to_shards=d_new,
-            probed=list(excluded), heal_gen=self._heal_gen)
+            probed=list(excluded), heal_gen=heal_gen)
         self._ticks_since_snapshot = self.snapshot_every
         log.warning("mesh_reexpanded", from_shards=d_old, to_shards=d_new,
                     probed=excluded)
         return {"from_shards": d_old, "shards": d_new,
-                "probed": excluded, "heal_gen": self._heal_gen}
+                "probed": excluded, "heal_gen": heal_gen}
 
     def _attest_and_repair(self) -> tuple[int, ...]:
         """Per-shard state attestation at a snapshot generation boundary
@@ -921,7 +928,9 @@ class ShieldedScorer:
                  # adopting, and compaction drops only heal records this
                  # snapshot already reflects (the params_swap discipline)
                  "mesh_shards": int(mesh_shards),
+                 # graft-audit: allow[lock-guard] snapshot capture is serialized against heals/reexpands by the shield _lock, so the pair below is consistent
                  "mesh_exclude": tuple(self._mesh_excluded),
+                 # graft-audit: allow[lock-guard] same shield-_lock serialization argument as mesh_exclude above
                  "heal_gen": int(self._heal_gen)}
         self.snapshots += 1
         self._ticks_since_snapshot = 0
@@ -1077,6 +1086,7 @@ class ShieldedScorer:
             "heals": self.heals,
             "reexpansions": self.reexpansions,
             "attest_repairs": self.attest_repairs,
+            # graft-audit: allow[lock-guard] monitoring read — a tuple swap is atomic under the GIL and staleness is acceptable in stats output
             "mesh_excluded": self._mesh_excluded,
             "serving_shards": self.scorer._graph_size(),
             "shard_health": self.health.stats(),

@@ -321,6 +321,7 @@ def _track_for_exit(scorer: "StreamingScorer") -> None:
 
 def _stop_all_warm() -> None:
     for s in list(_live_scorers):
+        # graft-audit: allow[lock-guard] atexit stop flag — the interpreter is exiting and a bool store is atomic under the GIL; taking _warm_lock here could deadlock against a warm thread mid-step
         s._warm_stop = True
 
 
@@ -1361,6 +1362,7 @@ class StreamingScorer:
                         _delta_pack(jnp.zeros(li + pk * dim, jnp.int32),
                                     li=li, pk=pk, dim=dim)
                     for pw in {cur_w, next_w}:
+                        # graft-audit: allow[lock-guard] cooperative-cancel fast path: a stale read only delays the stop by one warm compile step
                         if self._warm_stop:
                             return
                         r_pair = np.full((rk, width), pw, np.int32)
@@ -1475,6 +1477,7 @@ class StreamingScorer:
 
             for pk in pks:
                 for rk in rks:
+                    # graft-audit: allow[lock-guard] cooperative-cancel fast path: a stale read only delays the stop by one warm compile step
                     if self._warm_stop:
                         return
                     feats, tables, chain = standins()
@@ -1877,6 +1880,7 @@ class StreamingScorer:
             _snapshot_pack(feats, *tables)
         for pk in delta_sizes:
             for rk in row_sizes or (_ROW_BUCKETS[0],):
+                # graft-audit: allow[lock-guard] cooperative-cancel fast path: a stale read only delays the stop by one warm compile step
                 if self._warm_stop:
                     return
                 if g > 1:

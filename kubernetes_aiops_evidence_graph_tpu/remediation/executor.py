@@ -82,6 +82,7 @@ class RemediationExecutor:
             action.status = ActionStatus.SKIPPED
             action.status_reason = "duplicate idempotency key"
             return action
+        # graft-audit: allow[ledger-order] ledger-less mode (db=None): there is no intent store to write; the in-memory idempotency set above dedups within the process
         self._dispatch_one(action, handler)
         self._executed_keys.add(action.idempotency_key)
         return action

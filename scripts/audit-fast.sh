@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Fast pre-push audit loop: passes 2 (AST lint) and 4 (graft-sentinel)
+# only — both stdlib-only, no jax import, no jaxpr tracing — so the
+# whole repo checks in a couple of seconds. The full gate (jaxpr
+# invariants + cost ratchet) stays in CI:
+#
+#   python -m kubernetes_aiops_evidence_graph_tpu.analysis [--cost]
+#
+# Any extra flags pass through (e.g. --report json, --waivers).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m kubernetes_aiops_evidence_graph_tpu.analysis --skip-jaxpr "$@"

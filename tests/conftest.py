@@ -44,3 +44,26 @@ def pytest_sessionfinish(session, exitstatus):
                       f, indent=1)
     except Exception:
         pass
+
+
+# -- graft-sentinel runtime half: lock-order witness -------------------------
+# Opt-in via KAEG_LOCK_ORDER_GUARD=1 (the chaos CI jobs export it): every
+# lock created during the session is classed by allocation site and the
+# acquisition graph is checked for cycles — a single interleaving that
+# takes serve_lock then _lock while another path takes them reversed is
+# the deadlock shape, flagged even when this run never deadlocked.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_guard():
+    if os.environ.get("KAEG_LOCK_ORDER_GUARD") != "1":
+        yield None
+        return
+    from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+        LockOrderGuard)
+    guard = LockOrderGuard().install()
+    yield guard
+    guard.uninstall()
+    guard.assert_clean()

@@ -1,0 +1,18 @@
+"""Seeded violation: a pallas_call carrying input_output_aliases with no
+DMA_ALIAS_SITES registration — nothing ties the aliased operand to a
+donating jit wrapper or declares it trace-local scratch (rule
+``dma-alias``)."""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _accum_kernel(x_ref, acc_ref, out_ref):
+    out_ref[...] = acc_ref[...] + x_ref[...]
+
+
+def accumulate(x, acc):
+    return pl.pallas_call(
+        _accum_kernel,
+        out_shape=jax.ShapeDtypeStruct(acc.shape, acc.dtype),
+        input_output_aliases={1: 0},
+    )(x, acc)

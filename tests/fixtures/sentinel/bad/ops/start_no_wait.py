@@ -1,0 +1,18 @@
+"""Seeded violation: an async HBM->VMEM copy is started and never
+awaited — the compute races the in-flight DMA into its destination
+(rule ``dma-start-no-wait``)."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _stream_kernel(hbm_ref, out_ref, buf, sem):
+    pltpu.make_async_copy(hbm_ref, buf, sem).start()
+    out_ref[...] = buf[...] * 2.0     # <-- reads before any .wait()
+
+
+def stream(x):
+    return pl.pallas_call(
+        _stream_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

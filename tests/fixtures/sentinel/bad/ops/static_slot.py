@@ -1,0 +1,23 @@
+"""Seeded violation: both DMA starts land in constant slot 0 — the
+ping-pong alternation is lost and the second copy overwrites a buffer
+the compute still reads (rule ``dma-double-buffer``)."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pingpong_kernel(hbm_ref, out_ref, bufs, sem):
+    cp0 = pltpu.make_async_copy(hbm_ref.at[0], bufs.at[0], sem.at[0])
+    cp0.start()
+    cp1 = pltpu.make_async_copy(hbm_ref.at[1], bufs.at[0], sem.at[1])
+    cp1.start()                       # <-- same slot as cp0
+    cp0.wait()
+    cp1.wait()
+    out_ref[...] = bufs[0] + bufs[1]
+
+
+def pingpong(x):
+    return pl.pallas_call(
+        _pingpong_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

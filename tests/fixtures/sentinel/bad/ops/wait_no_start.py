@@ -1,0 +1,17 @@
+"""Seeded violation: a .wait() on a semaphore no copy ever signals —
+the grid deadlocks (rule ``dma-wait-no-start``)."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _drain_kernel(hbm_ref, out_ref, buf, sem):
+    pltpu.make_async_copy(hbm_ref, buf, sem).wait()   # <-- nothing started
+    out_ref[...] = buf[...]
+
+
+def drain(x):
+    return pl.pallas_call(
+        _drain_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,23 @@
+"""Seeded violation: the container lock is acquired INSIDE a scorer
+serve_lock — inverting the declared order (rule ``lock-order``)."""
+import threading
+
+GRAFT_SENTINEL = {
+    "lock_order": ["_lock", "serve_lock"],
+}
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.serve_lock = threading.Lock()
+
+    def swap_all(self, params):
+        with self._lock:              # declared order: fine
+            with self.serve_lock:
+                self.params = params
+
+    def broken(self, params):
+        with self.serve_lock:
+            with self._lock:          # <-- inversion: deadlock shape
+                self.params = params

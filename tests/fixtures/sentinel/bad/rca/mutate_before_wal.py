@@ -1,0 +1,19 @@
+"""Seeded violation: the scorer mutation runs before the WAL append on
+one path — a crash in the gap replays into a state that never existed
+(rule ``wal-order``)."""
+
+GRAFT_SENTINEL = {
+    "ordering": {"rule": "wal-order",
+                 "journal": ["journal.append"],
+                 "mutate": ["s.apply_records"],
+                 "exempt": "replay|recover"},
+}
+
+
+def stage_and_apply(journal, s, recs, seq):
+    s.apply_records(recs)             # <-- mutation first
+    journal.append((), seq, seq, kind="delta", records=recs)
+
+
+def replay_batch(s, recs):
+    s.apply_records(recs)             # exempt: replay path re-applies

@@ -1,0 +1,18 @@
+"""Seeded violation: a waiver pragma with no reason — the hygiene gate
+rejects it unconditionally (rule ``waiver-no-reason``, not itself
+waivable)."""
+import threading
+
+GRAFT_SENTINEL = {
+    "guarded_by": {"serve_lock": ["_gen"]},
+}
+
+
+class Scorer:
+    def __init__(self):
+        self.serve_lock = threading.Lock()
+        self._gen = 0
+
+    def generation(self):
+        # graft-audit: allow[lock-guard]
+        return self._gen

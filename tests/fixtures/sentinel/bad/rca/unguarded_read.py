@@ -1,0 +1,24 @@
+"""Seeded violation: a serve_lock-guarded resident attribute is read
+outside any ``with serve_lock`` scope (rule ``lock-guard``)."""
+import threading
+
+GRAFT_SENTINEL = {
+    "guarded_by": {"serve_lock": ["_params"]},
+    "held_fns": ["_swap_locked"],
+}
+
+
+class Scorer:
+    def __init__(self):
+        self.serve_lock = threading.Lock()
+        self._params = None
+
+    def _swap_locked(self, params):
+        self._params = params         # documented already-held seam: ok
+
+    def swap(self, params):
+        with self.serve_lock:
+            self._params = params     # guarded write: ok
+
+    def peek(self):
+        return self._params           # <-- unguarded read

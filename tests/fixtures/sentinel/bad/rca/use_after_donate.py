@@ -1,0 +1,17 @@
+"""Seeded violation: the resident mirror is passed in a donated position
+and then read after the call — a device-memory use-after-free (rule
+``use-after-donate``). The sanctioned pattern rebinds the name from the
+call's outputs."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("pk",))
+def _tick(state, delta, pk: int):
+    return state.at[delta[:pk]].add(1.0, mode="drop")
+
+
+def serve_step(state, delta):
+    out = _tick(state, delta, pk=4)
+    return out + state.sum()          # <-- reads the donated buffer

@@ -1,0 +1,19 @@
+"""Seeded violation: a cluster mutation dispatched with no intent row
+journaled first — crash-in-the-gap leaves an action the recovery scan
+cannot see (rule ``ledger-order``)."""
+
+GRAFT_SENTINEL = {
+    "ordering": {"rule": "ledger-order",
+                 "journal": ["db.execution_intent"],
+                 "mutate": ["self.dispatch_one"],
+                 "exempt": "reconcile|replay"},
+}
+
+
+class Executor:
+    def execute(self, db, action, handler):
+        if action.dry_run:
+            db.execution_intent(action.idempotency_key, action.payload)
+            return None
+        self.dispatch_one(action, handler)   # <-- no intent on this path
+        return action

@@ -1,0 +1,32 @@
+"""Patterns the sentinel must NOT flag (false-positive pins): rebinding
+from the donating call's outputs, branch-local rebinds, and fresh
+stand-ins per call."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("pk",))
+def _tick(state, delta, pk: int):
+    return state.at[delta[:pk]].add(1.0, mode="drop")
+
+
+def serve_step(state, delta):
+    state = _tick(state, delta, pk=4)   # sanctioned: rebind from outputs
+    return state + 1.0
+
+
+def branchy(state, delta, flag):
+    if flag:
+        state = _tick(state, delta, pk=4)
+        state = state * 2.0             # rebound on this path: fine
+    else:
+        state = state + 1.0             # never donated on this path
+    return state
+
+
+def fresh_standins(mk, delta):
+    for _ in range(3):
+        standin = mk()
+        _tick(standin, delta, pk=4)     # fresh buffer per call, unread
+    return delta

@@ -1,0 +1,50 @@
+"""Ordering patterns the sentinel must NOT flag: journal-then-mutate,
+the vacuous-empty guard, terminated branches, and exempt replay paths.
+Also pins the lock-guard held-flow: guarded access inside nested With
+scopes and held_fns seams."""
+import threading
+
+GRAFT_SENTINEL = {
+    "ordering": {"rule": "wal-order",
+                 "journal": ["journal.append"],
+                 "mutate": ["s.apply_records"],
+                 "exempt": "replay|recover"},
+    "guarded_by": {"serve_lock": ["_params"]},
+    "held_fns": ["_swap_locked"],
+    "lock_order": ["_lock", "serve_lock"],
+}
+
+
+def stage_and_apply(journal, s, recs, seq):
+    if recs:
+        journal.append((), seq, seq, kind="delta", records=recs)
+    s.apply_records(recs)             # vacuous-empty: nothing to mutate
+    return seq
+
+
+def guarded_fastpath(journal, s, recs, seq):
+    if not recs:
+        return seq                    # terminated branch: no mutation
+    journal.append((), seq, seq, kind="delta", records=recs)
+    s.apply_records(recs)
+    return seq
+
+
+def replay_all(s, batches):
+    for recs in batches:
+        s.apply_records(recs)         # exempt: replay re-applies durable
+
+
+class Scorer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.serve_lock = threading.Lock()
+        self._params = None
+
+    def _swap_locked(self, params):
+        self._params = params
+
+    def swap_all(self, params):
+        with self._lock:
+            with self.serve_lock:     # declared order: fine
+                self._params = params

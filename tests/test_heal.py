@@ -490,4 +490,7 @@ def test_bench_mesh_heal_record_emits_hermetically_on_cpu():
     assert rec["reshard_strictly_cheaper"] is True
     assert rec["mttr_reshard_ms"] < rec["mttr_rebuild_ms"]
     assert rec["halo_collectives_post_heal"] == {"psum": 1}
+    # end-to-end dead-device MTTR is unknowable on virtual CPU devices
+    # (no ICI link or HBM actually disappears): honest-nulled
+    assert rec["measured_dead_device_mttr_ms"] is None
     assert rec["platform"] == "cpu"
