@@ -39,6 +39,21 @@ Three passes:
   protocol, and the waiver-hygiene gate (every ``allow[...]`` pragma
   must carry a reason). Stdlib-only, so ``scripts/audit-fast.sh`` (AST +
   sentinel, no tracing) stays a seconds-scale pre-push loop.
+* **Pass 5 — graft-lattice** (`ladders`, `retrace`,
+  `dispatch_lattice`, `warm_check`): the COMPILE surface — the single
+  declared registry of every bucket ladder with its shape contracts
+  (monotone rungs, bounded gap ratios, tile/block divisibility,
+  coverage-to-500k-pods or a declared escalation), an AST lint for the
+  retrace hazards that mint unplanned executables (raw sizes into
+  static argnums, unbounded static domains, weak-type scalar
+  promotion, rebound closure-captured arrays), and the dispatch-lattice
+  proof: enumerate every serve-reachable tick variant (tier × quant ×
+  shards) and verify each is pre-compiled by a warm path that goes
+  through the SAME dispatch seam serving uses. The runtime half is the
+  env-gated :class:`~.runtime_guards.CompileFence`
+  (``KAEG_COMPILE_FENCE=1``), which attributes every post-warm compile
+  under the chaos suites to a lattice point and fails on any stray.
+  Stdlib-only, so it rides in ``scripts/audit-fast.sh``.
 * **graft-cost** (`cost_model`, `comms`, `baseline`, ``--cost``): the
   QUANTITATIVE dimension — a static roofline model per entrypoint
   (per-primitive FLOPs, HBM read/write bytes from operand/result avals,
@@ -63,13 +78,14 @@ __all__ = ["Finding", "Report", "run_audit"]
 
 
 def run_audit(root=None, jaxpr: bool = True, ast: bool = True,
-              cost: bool = False, sentinel: bool = True) -> Report:
+              cost: bool = False, sentinel: bool = True,
+              lattice: bool = True) -> Report:
     """Run the static passes and return a combined Report.
 
-    ``root`` overrides the source tree for the AST and sentinel passes
-    (fixture trees in tests); the jaxpr pass always audits the installed
-    package's registered entrypoints. ``cost=True`` adds the graft-cost
-    pass against the committed COST_BASELINE.json.
+    ``root`` overrides the source tree for the AST, sentinel, and
+    lattice passes (fixture trees in tests); the jaxpr pass always
+    audits the installed package's registered entrypoints. ``cost=True``
+    adds the graft-cost pass against the committed COST_BASELINE.json.
     """
     report = Report()
     if jaxpr:
@@ -81,6 +97,13 @@ def run_audit(root=None, jaxpr: bool = True, ast: bool = True,
     if sentinel:
         from .sentinel import run_sentinel
         report.extend(run_sentinel(root))
+    if lattice:
+        from .ladders import run_ladders
+        from .retrace import run_retrace
+        from .warm_check import run_warm_check
+        report.extend(run_ladders(root))
+        report.extend(run_retrace(root))
+        report.extend(run_warm_check(root))
     if cost:
         from .baseline import run_cost_pass
         findings, section = run_cost_pass()

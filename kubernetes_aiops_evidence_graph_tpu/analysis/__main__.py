@@ -25,6 +25,15 @@ write-ahead-of-mutation dominance, and the Pallas DMA protocol
 ``--waivers`` lists every waiver pragma with its reason (a reason-less
 waiver is a hard failure — the hygiene gate).
 
+Pass 5 (graft-lattice, stdlib-only, on by default) pins the COMPILE
+surface: the declared bucket-ladder registry and its shape contracts
+(analysis/ladders.py), the retrace-hazard lint over the hot dirs
+(analysis/retrace.py), and the dispatch-lattice enumeration + warm-
+coverage proof (analysis/dispatch_lattice.py, analysis/warm_check.py).
+``--skip-lattice`` disables it. The runtime half — the CompileFence
+that attributes every post-warm compile under the chaos suites — is
+env-gated via ``KAEG_COMPILE_FENCE=1`` (analysis/runtime_guards.py).
+
 ``--jaxpr-fixture dotted.module`` audits a module exposing an
 ``ENTRYPOINTS`` tuple instead of the built-in registry — how the
 seeded-violation fixtures under tests/fixtures/audit are driven (with
@@ -68,6 +77,10 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="skip pass 4 (concurrency & durability: "
                          "use-after-donate, lock/WAL discipline, DMA "
                          "protocol)")
+    ap.add_argument("--skip-lattice", action="store_true",
+                    help="skip pass 5 (compile surface: ladder "
+                         "contracts, retrace hazards, dispatch-lattice "
+                         "warm coverage)")
     ap.add_argument("--waivers", action="store_true",
                     help="list every `# graft-audit: allow[rule]` pragma "
                          "with its location, rules, and reason, then "
@@ -128,6 +141,13 @@ def main(argv: "list[str] | None" = None) -> int:
     if not args.skip_sentinel:
         from .sentinel import run_sentinel
         report.extend(run_sentinel(args.root))
+    if not args.skip_lattice:
+        from .ladders import run_ladders
+        from .retrace import run_retrace
+        from .warm_check import run_warm_check
+        report.extend(run_ladders(args.root))
+        report.extend(run_retrace(args.root))
+        report.extend(run_warm_check(args.root))
     if args.cost:
         from .baseline import run_cost_pass
         findings, section = run_cost_pass(

@@ -1,0 +1,196 @@
+"""Pass 5 (graft-lattice): the reachable dispatch lattice, enumerated.
+
+The serving stack dispatches across a multiplicative lattice of jitted
+variants — backend tier (XLA / pallas / fused / dma) × quantization
+(f32 / bf16 / int8) × graph shards × pipeline depth × bucket rung — and
+the tier choice is made per dispatch by ``GnnStreamingScorer``'s gate
+chain (``_dma_ok`` → ``_fused_ok`` → composed), then labeled by
+``_tick_entrypoint`` with the registry name the cost model prices.
+This module re-derives that mapping STATICALLY: it enumerates every
+settings combination the serve path admits, resolves each to the
+registry entrypoint the dispatcher would run, and hands warm_check the
+reachable set to prove warm coverage over.
+
+Two failure directions:
+
+* ``lattice-unreachable`` — a tick-family entrypoint declared in
+  :mod:`analysis.registry` that NO enumerated settings combination
+  reaches: a dead tier that still costs audit/baseline maintenance and,
+  worse, suggests the gate chain silently stopped selecting it.
+* the reverse direction (a reachable point with no registered
+  entrypoint or no warm coverage) is emitted by :mod:`.warm_check` as
+  ``warm-gap``.
+
+The enumeration mirrors the gate conditions in
+``rca/gnn_streaming.py`` (kept honest by the mirror test in
+tests/test_graft_lattice.py, which drives the REAL dispatcher through
+every tier and asserts the resolved entry is in the enumerated set):
+
+* sharded mirror (``serve_graph_shards > 1``) → the sharded tick,
+  before any tier gate;
+* DMA gate: ``gnn_tick_dma`` on, bucketed layout, compute dtype in
+  {f32, bf16}, AND (a quantized feature tier is selected OR the
+  resident fused tick's VMEM demand exceeds the budget);
+* fused gate: ``gnn_fused_tick`` on, bucketed, compute in {f32, bf16};
+* otherwise the composed tick (bucketed or not; ``gnn_pallas`` flips
+  its kernel lowering, not its entrypoint identity).
+
+The BUCKET-RUNG axis is deliberately not a per-point coordinate here:
+rungs are proven discrete by the ladder half (ladders.py) and proven
+warm at runtime by the CompileFence perf contract — the static lattice
+covers variant identity, the runtime fence covers rung coverage.
+
+The ``coalesced`` entries are the same executables at coalesced
+top-rung delta shapes (the rung axis again), declared reachable via
+:data:`RUNG_AXIS_VARIANTS`. The plain (un-bucketed) composed tick is a
+parity/debug path — serve-reachable only by turning ``gnn_bucketed``
+off, declared in :data:`OFF_SERVE_VARIANTS` with the reason.
+
+Stdlib-only: :mod:`analysis.registry` imports no jax at module level,
+so the fast audit loop stays seconds-scale.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from itertools import product
+
+from .findings import Finding, Report
+
+# settings axes the serve path dispatches over (flag space, not shapes)
+TIER_FLAGS = ("xla", "pallas", "fused", "dma")      # backend tier axis
+QUANTS = ("", "bfloat16", "int8")                   # feature-quant axis
+COMPUTE_DTYPES = (None, "bfloat16")                 # compute dtype axis
+SHARDS = (1, 2)                                     # graph-shard axis
+DEPTHS = (1, 2)                                     # pipeline-depth axis
+
+# same-executable variants reached along the bucket-rung axis (coalesced
+# churn ticks pack multiple event batches into one top-rung delta): the
+# static lattice maps them to their base tier; the rung coverage itself
+# is the CompileFence perf contract's job
+RUNG_AXIS_VARIANTS = {
+    "streaming.gnn_tick.coalesced": "streaming.gnn_tick.bucketed",
+    "streaming.rules_tick.coalesced": "streaming.rules_tick",
+    # the multi-tenant pack is the rules tick at PACK_BUCKETS rungs with
+    # per-tenant row offsets — pack-rung axis of the same executable
+    "streaming.rules_tick.multitenant": "streaming.rules_tick",
+}
+
+# declared tiers that are reachable but NOT on the serve path (need an
+# explicit settings flip a production config never makes); they are
+# exempt from warm coverage but still must trace in the jaxpr audit
+OFF_SERVE_VARIANTS = {
+    # parity/debug: gnn_bucketed=False serves the reference composed
+    # tick; production configs pin the bucketed ladder
+    "streaming.gnn_tick": "gnn_bucketed=False parity/debug path",
+}
+
+
+@dataclass(frozen=True)
+class LatticePoint:
+    """One reachable point of the serve-time dispatch lattice."""
+    tier: str          # "xla" | "pallas" | "fused" | "dma" | "sharded"
+    compute: "str | None"   # compute dtype static (None = f32)
+    quant: str         # feature-quant tier ("" = f32 features)
+    shards: int
+    depth: int
+    entry: str         # registry entrypoint name the dispatcher labels
+
+    @property
+    def label(self) -> str:
+        q = self.quant or "f32"
+        c = "bf16" if self.compute == "bfloat16" else "f32"
+        return (f"{self.entry}[tier={self.tier} compute={c} quant={q} "
+                f"D={self.shards} depth={self.depth}]")
+
+
+def resolve_entry(*, bucketed: bool, pallas: bool, fused: bool, dma: bool,
+                  compute: "str | None", quant: str, sharded: bool,
+                  vmem_over: bool) -> "tuple[str, str] | None":
+    """(entrypoint, tier) the dispatcher would label for one settings
+    combination — the static mirror of ``_tick_entrypoint`` +
+    ``_dma_ok``/``_fused_ok``. None = the combination cannot serve
+    (contradictory flags the constructor/gates refuse)."""
+    if quant and not dma:
+        return None        # a quant tier without the DMA tier never engages
+    if sharded:
+        return "streaming.gnn_tick.sharded", "sharded"
+    if dma and bucketed and compute in (None, "bfloat16") \
+            and (quant or vmem_over):
+        if quant == "int8":
+            return "streaming.gnn_tick.dma.int8", "dma"
+        if quant == "bfloat16":
+            return "streaming.gnn_tick.dma.bf16", "dma"
+        return "streaming.gnn_tick.dma", "dma"
+    if fused and bucketed and compute in (None, "bfloat16"):
+        return ("streaming.gnn_tick.fused.bf16", "fused") \
+            if compute == "bfloat16" \
+            else ("streaming.gnn_tick.fused", "fused")
+    if bucketed:
+        return ("streaming.gnn_tick.bucketed",
+                "pallas" if pallas else "xla")
+    return "streaming.gnn_tick", "xla"
+
+
+def enumerate_lattice() -> list[LatticePoint]:
+    """Every serve-reachable lattice point (bucketed serve configs)."""
+    points: set[LatticePoint] = set()
+    for (pallas, fused, dma, compute, quant, shards, depth,
+         vmem_over) in product(
+            (False, True), (False, True), (False, True),
+            COMPUTE_DTYPES, QUANTS, SHARDS, DEPTHS, (False, True)):
+        resolved = resolve_entry(
+            bucketed=True, pallas=pallas, fused=fused, dma=dma,
+            compute=compute, quant=quant, sharded=shards > 1,
+            vmem_over=vmem_over)
+        if resolved is None:
+            continue
+        entry, tier = resolved
+        points.add(LatticePoint(tier=tier, compute=compute, quant=quant,
+                                shards=shards, depth=depth, entry=entry))
+    # the base rules tick always serves alongside the GNN tick (the
+    # fold that produces the verdict), sharded or not
+    for shards, depth in product(SHARDS, DEPTHS):
+        points.add(LatticePoint(
+            tier="sharded" if shards > 1 else "xla", compute=None,
+            quant="", shards=shards, depth=depth,
+            entry="streaming.rules_tick.sharded" if shards > 1
+            else "streaming.rules_tick"))
+        points.add(LatticePoint(
+            tier="xla", compute=None, quant="", shards=shards,
+            depth=depth, entry="ingest.delta_pack"))
+    return sorted(points, key=lambda p: (p.entry, p.shards, p.depth,
+                                         str(p.compute), p.quant))
+
+
+def reachable_entries() -> set[str]:
+    return {p.entry for p in enumerate_lattice()}
+
+
+def _declared_tick_entries() -> set[str]:
+    """Tick-family entrypoint names the registry declares (module import
+    is jax-free; builders pull jax lazily)."""
+    from .registry import ENTRYPOINTS
+    return {e.name for e in ENTRYPOINTS
+            if e.name.startswith(("streaming.", "ingest."))}
+
+
+def check_unreachable() -> list[Finding]:
+    """``lattice-unreachable``: declared tick entrypoints no settings
+    combination reaches."""
+    declared = _declared_tick_entries()
+    reached = reachable_entries()
+    reached |= {v for v, base in RUNG_AXIS_VARIANTS.items()
+                if base in reached}
+    out: list[Finding] = []
+    for name in sorted(declared):
+        if name in reached or name in OFF_SERVE_VARIANTS:
+            continue
+        out.append(Finding(
+            rule="lattice-unreachable", where=f"registry:{name}",
+            message=f"declared tick entrypoint '{name}' is reachable by "
+                    "no enumerated settings combination — a dead tier "
+                    "still costs audit/baseline maintenance, or the "
+                    "dispatcher's gate chain silently stopped selecting "
+                    "it (update dispatch_lattice.resolve_entry or retire "
+                    "the entry)", pass_name="lattice"))
+    return out

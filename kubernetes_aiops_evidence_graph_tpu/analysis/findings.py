@@ -47,6 +47,13 @@ RULES: dict[str, tuple[str, str]] = {
     "dma-double-buffer": ("sentinel", "multiple DMA starts into one constant-indexed buffer slot (ping-pong lost)"),
     "dma-alias": ("sentinel", "aliased pallas_call site unregistered or its jit wrapper donates nothing"),
     "waiver-no-reason": ("sentinel", "# graft-audit: allow[...] pragma with no reason text"),
+    # pass 5 — graft-lattice (compile-surface: ladders, retrace, warm)
+    "ladder-gap": ("lattice", "bucket ladder violates a declared shape contract (non-monotone, gap ratio, or coverage without escalation)"),
+    "ladder-divisibility": ("lattice", "ladder rung breaks a declared divisibility contract (tile/block alignment)"),
+    "retrace-unbounded-static": ("lattice", "unquantized/unbounded value reaches a jit static argnum (one compile per distinct value)"),
+    "retrace-weak-type": ("lattice", "bare Python number in a traced jit position (weak-type promotion mints a second executable)"),
+    "warm-gap": ("lattice", "serve-reachable dispatch-lattice variant not covered by a verified warm path"),
+    "lattice-unreachable": ("lattice", "declared tick entrypoint reachable by no settings combination (dead tier)"),
     # cost pass — graft-cost ratchet
     "cost-flops": ("cost", "modeled FLOPs regressed beyond the +2% ratchet"),
     "cost-bytes": ("cost", "modeled HBM/peak-intermediate bytes regressed beyond the +5% ratchet"),

@@ -1,0 +1,259 @@
+"""Pass 5 (graft-lattice), ladder half: the ONE declared registry of
+every bucket ladder in the tree, plus the static contracts that keep the
+compile surface discrete.
+
+Every static shape the serving stack compiles is drawn from a bucket
+ladder — the delta/row churn ladders, the relation-slice capacity
+ladder, the node/edge/incident snapshot ladders, the evidence
+slot-width and pair-width ladders, the multi-tenant pack ladder, and
+the DMA node-block quantum. Before this module those rungs were
+re-declared across rca/streaming.py, rca/tpu_backend.py,
+graph/snapshot.py, ops/pallas_segment.py, config/settings.py,
+parallel/partition.py and analysis/registry.py; a one-sided edit (a
+rung added to the serving ladder but not the bench ladder, a capacity
+that stops dividing EDGE_TILE) silently mints mid-serve compiles or
+mis-tiled kernels. Now the defining modules IMPORT these constants
+(the drift-guard test in tests/test_graft_lattice.py pins the
+identity), and the checks below run in the stdlib-only fast audit:
+
+* ``ladder-gap``   — a ladder must be strictly increasing, its
+  consecutive-rung ratio bounded (worst-case padding inflation), and
+  its top rung must either cover the declared 500k-pod scale target or
+  declare a reachable above-ladder escalation (the rebuild path, the
+  ``_REL_SLICE_STEP`` rounding rule) — a ladder that just *ends* below
+  its workload turns bucket overflow into an unplanned off-ladder
+  compile mid-serve.
+* ``ladder-divisibility`` — tiling/sharding quanta must divide every
+  capacity drawn from the ladder: EDGE_TILE divides every relation-
+  slice rung AND the above-ladder step (tiles never straddle a slice),
+  and the DMA node-block quantum aligns with every node rung
+  (``pn % min(node_block, pn) == 0`` — rungs at or above the block are
+  block-multiples, smaller rungs divide the block).
+
+Fixture trees declare ladders inline with a module-level literal::
+
+    GRAFT_LADDERS = {
+        "my_ladder": {"rungs": [64, 256], "max_gap_ratio": 4.0,
+                      "covers": 500, "escalation": "none",
+                      "divisor": 64, "step": 0},
+    }
+
+This module is stdlib-only (never imports jax, numpy or the package
+runtime) so ``scripts/audit-fast.sh`` stays a seconds-scale loop and so
+the hot modules can import the rungs without an import cycle.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+from .findings import Finding, Report
+
+# -- canonical rungs ---------------------------------------------------------
+# The single source of truth. Defining modules import these (aliased to
+# their historical private names); values are byte-for-byte the ladders
+# the serving stack compiled before the dedupe — no static shape, jit
+# cache key or cost baseline moves.
+
+# scale target the topology ladders must reach (graft-tide stretched the
+# node/edge rungs for 500k-pod configs; the coverage check pins it)
+MAX_PODS = 500_000
+
+# streaming churn ladders (rca/streaming.py): feature-delta rows and
+# evidence-row-delta rows per tick
+DELTA_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
+ROW_BUCKETS = (4, 16, 64, 256)
+
+# snapshot-path edge ladder (rca/tpu_backend.py)
+EDGE_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
+# dense evidence slot-width / pair-width ladders (rca/tpu_backend.py)
+WIDTH_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
+# multi-tenant packed-incident ladder (rca/tpu_backend.py, graft-surge)
+PACK_BUCKETS = (8, 32, 128, 512, 2048)
+
+# relation-slice capacity ladder + above-ladder rounding step
+# (graph/snapshot.py; shared by build_snapshot, parallel/partition.py
+# and the streaming edge mirror)
+REL_SLICE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192,
+                     16384, 24576, 32768)
+REL_SLICE_STEP = 8192
+
+# snapshot topology ladders (config/settings.py defaults; graft-tide
+# stretched node/edge rungs to 500k-pod scale)
+NODE_BUCKET_SIZES = (256, 1024, 4096, 16384, 65536, 262144, 524288)
+EDGE_BUCKET_SIZES = (1024, 4096, 16384, 65536, 262144, 1048576, 4194304)
+INCIDENT_BUCKET_SIZES = (8, 32, 128, 512)
+
+# kernel tiling quanta: edge rows per Pallas grid step
+# (ops/pallas_segment.py) and the DMA streaming node-block
+# (analysis/registry.py / settings.gnn_dma_node_block default)
+EDGE_TILE = 64
+DMA_NODE_BLOCK = 2048
+
+
+@dataclass(frozen=True)
+class Ladder:
+    """One declared bucket ladder and its contracts.
+
+    ``escalation`` names the above-ladder path: ``"rebuild"`` (bucket
+    overflow escalates to the store-derived rebuild — NeedsRebuild),
+    ``"step"`` (counts beyond the top rung round to ``step`` multiples,
+    the rel_slice_offsets rule), or ``"none"`` (the top rung must cover
+    ``covers`` outright). ``divisor`` must divide every rung;
+    ``divisor_min`` relaxes it to the ``min(divisor, rung)`` alignment
+    rule the DMA dispatcher actually checks."""
+    name: str
+    rungs: tuple
+    defined_in: str               # "module.py:ATTR" provenance
+    max_gap_ratio: float = 4.0
+    covers: int = 0               # 0 = no coverage target
+    escalation: str = "none"      # "rebuild" | "step" | "none"
+    step: int = 0                 # above-ladder rounding (escalation="step")
+    divisor: int = 0              # 0 = no divisibility contract
+    divisor_min: bool = False     # min(divisor, rung) alignment semantics
+
+
+# the declared registry — every ladder in the tree, with its contracts
+LADDERS: tuple[Ladder, ...] = (
+    Ladder("delta", DELTA_BUCKETS, "rca/streaming.py:_DELTA_BUCKETS",
+           covers=MAX_PODS, escalation="rebuild"),
+    Ladder("row", ROW_BUCKETS, "rca/streaming.py:_ROW_BUCKETS",
+           escalation="rebuild"),
+    Ladder("edge", EDGE_BUCKETS, "rca/tpu_backend.py:_EDGE_BUCKETS",
+           escalation="rebuild"),
+    Ladder("width", WIDTH_BUCKETS, "rca/tpu_backend.py:_WIDTH_BUCKETS",
+           max_gap_ratio=2.0, escalation="rebuild"),
+    Ladder("pair_width", PAIR_WIDTH_BUCKETS,
+           "rca/tpu_backend.py:_PAIR_WIDTH_BUCKETS",
+           max_gap_ratio=2.0, escalation="rebuild"),
+    Ladder("pack", PACK_BUCKETS, "rca/tpu_backend.py:_PACK_BUCKETS",
+           escalation="rebuild"),
+    Ladder("rel_slice", REL_SLICE_BUCKETS,
+           "graph/snapshot.py:REL_SLICE_BUCKETS",
+           max_gap_ratio=2.0, covers=8 * MAX_PODS, escalation="step",
+           step=REL_SLICE_STEP, divisor=EDGE_TILE),
+    Ladder("node", NODE_BUCKET_SIZES,
+           "config/settings.py:node_bucket_sizes",
+           covers=MAX_PODS, divisor=DMA_NODE_BLOCK, divisor_min=True),
+    Ladder("edge_snapshot", EDGE_BUCKET_SIZES,
+           "config/settings.py:edge_bucket_sizes", covers=8 * MAX_PODS),
+    Ladder("incident", INCIDENT_BUCKET_SIZES,
+           "config/settings.py:incident_bucket_sizes",
+           escalation="rebuild"),
+)
+
+
+# -- checks ------------------------------------------------------------------
+
+def check_ladder(lad: Ladder, where: str) -> list[Finding]:
+    """The static contracts for ONE ladder (pure, stdlib-only)."""
+    out: list[Finding] = []
+
+    def hit(rule: str, msg: str) -> None:
+        out.append(Finding(rule=rule, where=where,
+                           message=f"ladder '{lad.name}': {msg}",
+                           pass_name="lattice"))
+
+    rungs = tuple(int(r) for r in lad.rungs)
+    if not rungs:
+        hit("ladder-gap", "declared with no rungs")
+        return out
+    if rungs[0] <= 0:
+        hit("ladder-gap", f"rung {rungs[0]} is not positive")
+    for lo, hi in zip(rungs[:-1], rungs[1:]):
+        if hi <= lo:
+            hit("ladder-gap",
+                f"rungs not strictly increasing at {lo} -> {hi} "
+                "(bucket_for would never select the shadowed rung)")
+        elif lo > 0 and hi / lo > lad.max_gap_ratio:
+            hit("ladder-gap",
+                f"rung gap {lo} -> {hi} exceeds the {lad.max_gap_ratio:g}x "
+                "padding-inflation bound — a count just past the lower "
+                "rung pads to more than "
+                f"{lad.max_gap_ratio:g}x its live size")
+    if lad.covers:
+        top = rungs[-1]
+        if lad.escalation == "step":
+            if lad.step <= 0:
+                hit("ladder-gap",
+                    "declares step escalation with no rounding step — "
+                    "counts beyond the top rung have no planned capacity")
+        elif lad.escalation == "none" and top < lad.covers:
+            hit("ladder-gap",
+                f"top rung {top} does not cover the declared scale "
+                f"target {lad.covers} and no above-ladder escalation is "
+                "declared — overflow mints an unplanned off-ladder "
+                "compile mid-serve")
+    elif lad.escalation == "step" and lad.step <= 0:
+        hit("ladder-gap", "step escalation with no rounding step")
+    if lad.divisor:
+        for r in rungs:
+            if lad.divisor_min:
+                ok = (r % lad.divisor == 0 if r >= lad.divisor
+                      else lad.divisor % r == 0)
+            else:
+                ok = r % lad.divisor == 0
+            if not ok:
+                hit("ladder-divisibility",
+                    f"rung {r} does not align with the declared quantum "
+                    f"{lad.divisor} (tiles/blocks would straddle a "
+                    "capacity boundary)")
+        if lad.step and lad.step % lad.divisor != 0:
+            hit("ladder-divisibility",
+                f"above-ladder step {lad.step} is not a multiple of the "
+                f"quantum {lad.divisor} — beyond-top capacities would "
+                "lose tile alignment exactly when slices are largest")
+    return out
+
+
+def _fixture_ladders(path: Path, rel: str) -> list[tuple[Ladder, str]]:
+    """Module-level ``GRAFT_LADDERS = {...}`` literals (fixture trees)."""
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError:
+        return []
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "GRAFT_LADDERS"):
+            try:
+                decl = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError):
+                return []
+            if not isinstance(decl, dict):
+                return []
+            out = []
+            for name, spec in sorted(decl.items()):
+                out.append((Ladder(
+                    name=str(name),
+                    rungs=tuple(spec.get("rungs", ())),
+                    defined_in=f"{rel}:{node.lineno}",
+                    max_gap_ratio=float(spec.get("max_gap_ratio", 4.0)),
+                    covers=int(spec.get("covers", 0)),
+                    escalation=str(spec.get("escalation", "none")),
+                    step=int(spec.get("step", 0)),
+                    divisor=int(spec.get("divisor", 0)),
+                    divisor_min=bool(spec.get("divisor_min", False)),
+                ), f"{rel}:{node.lineno}"))
+            return out
+    return []
+
+
+def run_ladders(root: "Path | str | None" = None) -> Report:
+    """Check the declared registry (default) or every ``GRAFT_LADDERS``
+    literal under a fixture ``root``."""
+    report = Report()
+    if root is None:
+        for lad in LADDERS:
+            report.findings.extend(check_ladder(lad, lad.defined_in))
+        return report
+    base = Path(root)
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        for lad, where in _fixture_ladders(path, rel):
+            report.findings.extend(check_ladder(lad, where))
+    return report

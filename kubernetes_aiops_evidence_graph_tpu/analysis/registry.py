@@ -19,6 +19,7 @@ from typing import Any, Callable
 
 from .comms import COST_DEFAULT, CostSpec
 from .invariants import CALLBACK_PRIMS, InvariantSpec
+from .ladders import DMA_NODE_BLOCK as _DMA_NODE_BLOCK
 
 MIB = 1 << 20
 
@@ -73,7 +74,7 @@ PALLAS_VJP_BUDGET = 6 * MIB
 # plus tile-scale edge math, so 8 MiB comfortably admits the windowed
 # math and rejects any [N, H]-resident (4 MiB × co-live tables) or
 # [E, H] materialization that would mean the kernel stopped streaming.
-DMA_NODE_BLOCK = 2048
+DMA_NODE_BLOCK = _DMA_NODE_BLOCK   # declared in analysis/ladders.py
 DMA_TICK_BUDGET = 8 * MIB
 
 # bucketed forward paths may not contain a set-scatter at all — the only

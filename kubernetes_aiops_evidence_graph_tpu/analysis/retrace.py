@@ -1,0 +1,270 @@
+"""Pass 5 (graft-lattice), retrace half: AST lint for retrace hazards.
+
+The zero-post-warm-compile SLO holds only if every value that reaches a
+jit cache key is drawn from a small declared domain. Pass 2 pins the
+*signatures* (static/donate declarations); this pass pins the *values*
+flowing through them — the four hazard shapes that mint unplanned
+compiles at serve time:
+
+* ``retrace-unbounded-static`` —
+  (a) a raw size expression (``len(...)``, ``.shape``) passed into a
+  declared static argnum without going through a ladder quantizer
+  (``bucket_for`` / ``rel_slice_offsets``): the cache key then tracks
+  the live count, one compile per distinct value;
+  (b) a ``str``/``dict``-annotated static parameter of a hot-dir jitted
+  function with no entry in :data:`STATIC_DOMAINS` — an unbounded
+  static domain is an unbounded executable cache;
+  (c) a module-level array constant closure-captured inside a jitted
+  function *and rebound elsewhere* — the capture bakes the array into
+  the trace as a constant, so every rebind silently mints a fresh
+  executable (constants assigned exactly once, like the baked rule
+  tensors in rca/tpu_backend.py, are the sanctioned pattern and clean).
+* ``retrace-weak-type`` — a bare Python numeric literal in a traced
+  (non-static) position of a known jitted call: weak-type promotion
+  gives the scalar a different aval than the same value arriving as a
+  committed-dtype array, so call sites that mix the two retrace — pass
+  ``jnp.asarray(x, dtype)`` or make the argument static.
+
+Known jitted callables are the union of :data:`~.ast_lint.
+JIT_DECLARATIONS` (the tree-wide registry) and the jit sites declared
+in the same module (how fixture trees participate). Waivers follow the
+standard ``# graft-audit: allow[rule] reason`` pragma. Stdlib-only —
+part of the ``scripts/audit-fast.sh`` seconds-scale loop.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .ast_lint import (HOT_DIRS, JIT_DECLARATIONS, _call_name,
+                       _jit_decoration, _static_argnames_from_call,
+                       package_root)
+from .findings import Finding, Report
+from .sentinel import _comment_waivers
+
+# calls that map a raw count onto a declared ladder — an expression that
+# passes through one of these is quantized, not unbounded
+QUANTIZERS = {"bucket_for", "rel_slice_offsets"}
+
+# declared value domains for string-typed statics: the dispatcher's
+# compute/quant tiers. A str static NOT listed here has an unbounded
+# domain — every new spelling is a new executable.
+STATIC_DOMAINS: dict[str, tuple] = {
+    "compute_dtype": (None, "bfloat16"),
+    "feat_quant": ("", "bfloat16", "int8"),
+}
+
+# statics known tree-wide, keyed by bare function name
+_DECLARED_STATICS: dict[str, set] = {}
+for (_rel, _fn), (_statics, _donate) in JIT_DECLARATIONS.items():
+    _DECLARED_STATICS.setdefault(_fn, set()).update(_statics)
+
+_ARRAY_MAKER_PREFIXES = ("np.", "numpy.", "jnp.", "jax.numpy.")
+
+
+def _is_array_maker(expr) -> bool:
+    return (isinstance(expr, ast.Call)
+            and _call_name(expr).startswith(_ARRAY_MAKER_PREFIXES))
+
+
+def _size_flow(expr) -> str:
+    """'quantized' | 'raw' | 'opaque' for a static-arg value expression."""
+    raw = False
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            name = _call_name(n).rsplit(".", 1)[-1]
+            if name in QUANTIZERS:
+                return "quantized"
+            if name == "len":
+                raw = True
+        elif isinstance(n, ast.Attribute) and n.attr in ("shape", "size"):
+            raw = True
+    return "raw" if raw else "opaque"
+
+
+def _numeric_literal(expr) -> bool:
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op,
+                                                    (ast.USub, ast.UAdd)):
+        expr = expr.operand
+    return (isinstance(expr, ast.Constant)
+            and type(expr.value) in (int, float))
+
+
+class _FileRetrace:
+    def __init__(self, path: Path, rel: str, source: str):
+        self.rel = rel
+        self.tree = ast.parse(source)
+        self.findings: list[Finding] = []
+        self.waivers = _comment_waivers(source)
+        # local jit sites: name -> (statics, param order)
+        self.local_jits: dict[str, tuple[set, tuple]] = {}
+        call_form: dict[str, set] = {}
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call) and _call_name(n) in ("jax.jit",
+                                                             "jit"):
+                statics, _don = _static_argnames_from_call(n)
+                if n.args and isinstance(n.args[0], ast.Name):
+                    call_form[n.args[0].id] = statics
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.FunctionDef):
+                continue
+            dec = _jit_decoration(n)
+            statics = dec[0] if dec is not None else call_form.get(n.name)
+            if statics is None:
+                continue
+            params = tuple(a.arg for a in list(n.args.args)
+                           + list(n.args.kwonlyargs))
+            self.local_jits[n.name] = (set(statics), params)
+        # module-level array constants: name -> number of module-level binds
+        self.array_binds: dict[str, int] = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and _is_array_maker(node.value)):
+                name = node.targets[0].id
+                self.array_binds[name] = self.array_binds.get(name, 0) + 1
+        # names rebound through `global` inside any function
+        self.global_rebinds: set[str] = set()
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.FunctionDef):
+                continue
+            declared_global = {g for s in ast.walk(n)
+                               if isinstance(s, ast.Global)
+                               for g in s.names}
+            if not declared_global:
+                continue
+            for s in ast.walk(n):
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id in declared_global:
+                            self.global_rebinds.add(t.id)
+
+    def hit(self, rule: str, line: int, message: str) -> None:
+        waived, reason = False, ""
+        for ln in (line, line - 1):
+            w = self.waivers.get(ln)
+            if w and (rule in w[0] or "all" in w[0]):
+                waived, reason = True, w[1]
+                break
+        self.findings.append(Finding(
+            rule=rule, where=f"{self.rel}:{line}", message=message,
+            pass_name="lattice", waived=waived, waiver_reason=reason))
+
+    def _statics_of(self, bare: str) -> "set | None":
+        if bare in self.local_jits:
+            return self.local_jits[bare][0]
+        return _DECLARED_STATICS.get(bare)
+
+    def lint(self) -> list[Finding]:
+        self._static_domains()
+        self._call_sites()
+        self._closure_capture()
+        return self.findings
+
+    # (b) unbounded static domains ------------------------------------
+    def _static_domains(self) -> None:
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.FunctionDef) \
+                    or n.name not in self.local_jits:
+                continue
+            statics, _params = self.local_jits[n.name]
+            for a in list(n.args.args) + list(n.args.kwonlyargs):
+                ann = a.annotation
+                if not (isinstance(ann, ast.Name)
+                        and ann.id in ("str", "dict")):
+                    continue
+                if a.arg in statics and a.arg not in STATIC_DOMAINS:
+                    self.hit(
+                        "retrace-unbounded-static", n.lineno,
+                        f"static parameter '{a.arg}: {ann.id}' of jitted "
+                        f"'{n.name}' has no declared value domain "
+                        "(analysis.retrace.STATIC_DOMAINS) — an unbounded "
+                        "static domain is an unbounded executable cache")
+
+    # (a) raw sizes into statics + weak-type literals ------------------
+    def _call_sites(self) -> None:
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            bare = _call_name(n).rsplit(".", 1)[-1]
+            statics = self._statics_of(bare)
+            if statics is None:
+                continue
+            params = (self.local_jits[bare][1]
+                      if bare in self.local_jits else None)
+            for kw in n.keywords:
+                if kw.arg is None:
+                    continue
+                if kw.arg in statics:
+                    if _size_flow(kw.value) == "raw":
+                        self.hit(
+                            "retrace-unbounded-static", n.lineno,
+                            f"raw size expression flows into static "
+                            f"'{kw.arg}' of jitted '{bare}' without a "
+                            "ladder quantizer (bucket_for / "
+                            "rel_slice_offsets) — the jit cache key "
+                            "tracks the live count, one compile per "
+                            "distinct value")
+                elif _numeric_literal(kw.value):
+                    self.hit(
+                        "retrace-weak-type", n.lineno,
+                        f"bare Python number for traced argument "
+                        f"'{kw.arg}' of jitted '{bare}': weak-type "
+                        "promotion gives it a different aval than a "
+                        "committed-dtype array — pass jnp.asarray(x, "
+                        "dtype) or declare it static")
+            for i, arg in enumerate(n.args):
+                if not _numeric_literal(arg):
+                    continue
+                if params is not None and i < len(params) \
+                        and params[i] in statics:
+                    continue   # a static passed positionally: not traced
+                self.hit(
+                    "retrace-weak-type", n.lineno,
+                    f"bare Python number in traced position {i} of "
+                    f"jitted '{bare}': weak-type promotion gives it a "
+                    "different aval than a committed-dtype array — pass "
+                    "jnp.asarray(x, dtype) or declare it static")
+
+    # (c) closure-captured arrays that get rebound ---------------------
+    def _closure_capture(self) -> None:
+        hazardous = {name for name, binds in self.array_binds.items()
+                     if binds > 1 or name in self.global_rebinds}
+        if not hazardous:
+            return
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.FunctionDef) \
+                    or n.name not in self.local_jits:
+                continue
+            seen: set[str] = set()
+            for s in ast.walk(n):
+                if isinstance(s, ast.Name) \
+                        and isinstance(s.ctx, ast.Load) \
+                        and s.id in hazardous and s.id not in seen:
+                    seen.add(s.id)
+                    self.hit(
+                        "retrace-unbounded-static", s.lineno,
+                        f"jitted '{n.name}' closure-captures module "
+                        f"array '{s.id}', which is rebound elsewhere — "
+                        "each rebind bakes a fresh constant into the "
+                        "trace and mints a new executable; pass it as "
+                        "an operand (or never rebind it)")
+
+
+def run_retrace(root: "Path | str | None" = None) -> Report:
+    """Lint the hot dirs under ``root`` (default: installed package)."""
+    base = Path(root) if root is not None else package_root()
+    report = Report()
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(base).as_posix()
+        if not set(Path(rel).parts[:-1]) & HOT_DIRS:
+            continue
+        try:
+            fr = _FileRetrace(path, rel, path.read_text())
+        except SyntaxError:
+            continue    # pass 2 already reports syntax-error
+        report.findings.extend(fr.lint())
+    return report

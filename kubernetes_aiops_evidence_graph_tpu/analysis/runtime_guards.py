@@ -23,6 +23,13 @@ streaming-churn workload.
   site-level acquisition graph per thread, and flags any edge that
   closes a cycle — the two-thread deadlock shape, caught from a
   single-threaded witness. The chaos suites run under it in CI.
+* :class:`CompileFence` — pass 5's runtime half. Opt-in
+  (``KAEG_COMPILE_FENCE=1``, exported by the chaos CI jobs): hooks
+  jax's backend-compile monitoring event and, inside an armed window
+  (post-warm), attributes every compile to the enclosing
+  :meth:`~CompileFence.region` label — any entry at all fails
+  :meth:`~CompileFence.assert_clean`, which is the
+  zero-post-warm-compile SLO observed rather than argued.
 """
 from __future__ import annotations
 
@@ -273,3 +280,137 @@ def maybe_install_lock_order_guard() -> "LockOrderGuard | None":
     if os.environ.get(LockOrderGuard.ENV) != "1":
         return None
     return LockOrderGuard().install()
+
+
+class CompileFence:
+    """Pass 5's runtime half: attribute every post-warm XLA compile.
+
+    The static lattice proves every serve-reachable variant HAS a warm
+    path; the fence proves the warm paths actually pre-compile every
+    executable the workload then requests — the property the
+    zero-post-warm-compile SLO rests on, observed, not argued.
+
+    Signal: jax's ``/jax/core/compile/backend_compile_duration``
+    monitoring event, which fires once per backend compile and never on
+    an executable-cache hit. jax 0.4.x has no per-listener unregister
+    (only a global ``clear_event_listeners``), so the fence registers
+    ONE module-level listener lazily and gates it on the active
+    instance — install/uninstall flips the gate rather than touching
+    jax's listener list, which keeps the fence composable with other
+    monitoring users.
+
+    Accounting is WINDOWED: compiles are only charged while the fence is
+    armed (:meth:`armed` / :meth:`arm`/:meth:`disarm`), so cold-start
+    and warm-path compiles — the legitimate ones — never count. Inside
+    an armed window, :meth:`region` pushes a thread-local label (a
+    lattice-point label, a test id) onto the attribution stack; a
+    compile observed with no region on the stack is charged to
+    ``"<unattributed>"``. The chaos CI jobs opt in with
+    ``KAEG_COMPILE_FENCE=1`` (same discipline as the lock guard); the
+    perf-contract test in tests/test_graft_lattice.py arms the fence
+    after warm() and asserts :meth:`assert_clean` across the full tier
+    × quant × shards × depth sweep, a forced mid-script rebuild, and an
+    adopt_mesh heal.
+    """
+
+    ENV = "KAEG_COMPILE_FENCE"
+    EVENT = "/jax/core/compile/backend_compile_duration"
+
+    _listener_registered = False
+    _active: "CompileFence | None" = None
+
+    def __init__(self):
+        self.violations: list[dict] = []
+        self._armed = False
+        self._tls = threading.local()
+        self._meta = threading.Lock()
+
+    # -- the one jax-side listener ------------------------------------
+
+    @classmethod
+    def _ensure_listener(cls) -> None:
+        if cls._listener_registered:
+            return
+        import jax
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            fence = cls._active
+            if fence is not None and event == cls.EVENT:
+                fence._note_compile(duration)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        cls._listener_registered = True
+
+    def install(self) -> "CompileFence":
+        self._ensure_listener()
+        type(self)._active = self
+        return self
+
+    def uninstall(self) -> None:
+        if type(self)._active is self:
+            type(self)._active = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- armed-window + attribution bookkeeping -----------------------
+
+    def arm(self) -> None:
+        """Start charging compiles (call AFTER the warm paths ran)."""
+        self._armed = True
+
+    def disarm(self) -> None:
+        self._armed = False
+
+    def _regions(self) -> list:
+        stack = getattr(self._tls, "regions", None)
+        if stack is None:
+            stack = self._tls.regions = []
+        return stack
+
+    @contextlib.contextmanager
+    def region(self, label: str):
+        """Attribute compiles observed in this block to ``label``."""
+        stack = self._regions()
+        stack.append(label)
+        try:
+            yield self
+        finally:
+            stack.pop()
+
+    def _note_compile(self, duration: float) -> None:
+        if not self._armed:
+            return
+        stack = self._regions()
+        label = stack[-1] if stack else "<unattributed>"
+        with self._meta:
+            self.violations.append({
+                "region": label,
+                "thread": threading.current_thread().name,
+                "duration_secs": duration,
+            })
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            regions = sorted({v["region"] for v in self.violations})
+            raise AssertionError(
+                f"{len(self.violations)} post-warm compile(s) observed "
+                f"inside the fenced window (regions: {regions}): "
+                f"{self.violations} — a serve-reachable variant was not "
+                "pre-compiled by its declared warm path, or a retrace "
+                "hazard minted a fresh executable")
+
+
+def maybe_install_compile_fence() -> "CompileFence | None":
+    """Session hook: install iff ``KAEG_COMPILE_FENCE=1`` (exported by
+    the chaos CI jobs next to the lock guard). The fence installs
+    DISARMED — suites arm it themselves after their warm phase, so
+    opting a whole job in never misattributes legitimate cold
+    compiles."""
+    if os.environ.get(CompileFence.ENV) != "1":
+        return None
+    return CompileFence().install()

@@ -1,0 +1,247 @@
+"""Pass 5 (graft-lattice), warm half: the warm-coverage proof.
+
+:mod:`.dispatch_lattice` enumerates which tick variants serving can
+dispatch; this module proves each of them is PRE-COMPILED by a declared
+warm path — i.e. that the zero-post-warm-compile SLO is covered by
+construction, not by the luck of which settings the chaos suites happen
+to exercise. Coverage is declared in :data:`WARM_DECLARATIONS` as
+``entrypoint -> (module, warm_fn, dispatch seam)`` and each declaration
+is then VERIFIED against the source tree by AST:
+
+* the module exists and defines ``warm_fn``;
+* the dispatch seam — the function the serve path itself goes through
+  (``_call_gnn_tick`` for the single-device GNN tiers, ``_tick_fn`` for
+  the rules tick, the ``sharded_*_tick`` builders for the mesh tiers) —
+  is reachable from ``warm_fn`` through the module-local call graph.
+  Warming THROUGH the serve seam is the load-bearing property: it means
+  the warm call compiles exactly the executable serving will request,
+  whatever tier the live settings select, so the declaration cannot rot
+  into warming a lookalike.
+
+``warm-gap`` fires when a serve-reachable lattice entry has no
+declaration, when a declared warm fn or module is missing, or when the
+seam is not reachable from the warm fn (the warm path stopped going
+through the dispatcher — it now warms something else). The companion
+``lattice-unreachable`` (dead declared tiers) comes from
+:func:`dispatch_lattice.check_unreachable` and is folded into the same
+report.
+
+Fixture trees participate via a module-level ``GRAFT_LATTICE = {...}``
+literal (mirroring ``GRAFT_SENTINEL`` / ``GRAFT_LADDERS``)::
+
+    GRAFT_LATTICE = {
+        "reachable": ["tick.a", "tick.b"],   # serve-reachable entries
+        "declared": ["tick.a", "tick.b"],    # registry declarations
+        "warm": {"tick.a": "warm_a"},        # entry -> warm fn in module
+    }
+
+``warm-gap``: a reachable entry missing from ``warm`` or whose warm fn
+is not defined in the module. ``lattice-unreachable``: a declared entry
+absent from ``reachable``. Stdlib-only.
+"""
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from .ast_lint import _call_name, package_root
+from .dispatch_lattice import (OFF_SERVE_VARIANTS, RUNG_AXIS_VARIANTS,
+                               check_unreachable, reachable_entries)
+from .findings import Finding, Report
+from .sentinel import _comment_waivers
+
+# entrypoint -> (module rel path, warm fn, serve-dispatch seam).
+# seam=None means existence-only: the warm fn IS the coverage (e.g. the
+# surge growth pre-buckets, which return the shapes the generic warm
+# loop then drives through the normal seam).
+WARM_DECLARATIONS: dict[str, tuple] = {
+    "streaming.rules_tick":
+        ("rca/streaming.py", "warm", "_tick_fn"),
+    "streaming.rules_tick.coalesced":
+        ("rca/streaming.py", "warm", "_tick_fn"),
+    "streaming.rules_tick.sharded":
+        ("rca/streaming.py", "warm_mesh", "sharded_rules_tick"),
+    "streaming.rules_tick.multitenant":
+        ("rca/surge.py", "_growth_warm_buckets", None),
+    # every single-device GNN tier warms through the SAME dispatch seam
+    # serving uses, so whichever tier the live settings select is the
+    # one warm_gnn compiles — one declaration per tier keeps the proof
+    # explicit per lattice entry even though the seam is shared
+    "streaming.gnn_tick.bucketed":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.coalesced":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.fused":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.fused.bf16":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.dma":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.dma.bf16":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.dma.int8":
+        ("rca/gnn_streaming.py", "warm_gnn", "_call_gnn_tick"),
+    "streaming.gnn_tick.sharded":
+        ("rca/gnn_streaming.py", "_warm_gnn_sharded", "sharded_gnn_tick"),
+    "ingest.delta_pack":
+        ("rca/streaming.py", "warm", "_delta_pack"),
+}
+
+
+class _ModuleGraph:
+    """Module-local call graph: FunctionDef name -> bare call names in
+    its body (``self.x()`` and ``x()`` both resolve to ``x``)."""
+
+    def __init__(self, source: str):
+        tree = ast.parse(source)
+        self.defs: dict[str, set] = {}
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.FunctionDef):
+                continue
+            calls = {_call_name(c).rsplit(".", 1)[-1]
+                     for c in ast.walk(n) if isinstance(c, ast.Call)}
+            # a later duplicate def (e.g. an overload in a subclass)
+            # unions rather than shadows: coverage needs ANY path
+            self.defs.setdefault(n.name, set()).update(calls)
+
+    def reaches(self, start: str, seam: str) -> bool:
+        """Is a call to ``seam`` reachable from ``start`` through
+        functions defined in this module?"""
+        if start not in self.defs:
+            return False
+        seen, frontier = set(), [start]
+        while frontier:
+            fn = frontier.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            calls = self.defs.get(fn, set())
+            if seam in calls:
+                return True
+            frontier.extend(c for c in calls if c in self.defs)
+        return False
+
+
+def _check_real_tree(base: Path) -> list[Finding]:
+    out: list[Finding] = []
+    graphs: dict[str, _ModuleGraph] = {}
+
+    def graph_for(rel: str) -> "_ModuleGraph | None":
+        if rel not in graphs:
+            path = base / rel
+            graphs[rel] = (_ModuleGraph(path.read_text())
+                           if path.is_file() else None)
+        return graphs[rel]
+
+    covered = set(WARM_DECLARATIONS) | set(OFF_SERVE_VARIANTS)
+    for entry in sorted(reachable_entries()):
+        if entry not in covered:
+            out.append(Finding(
+                rule="warm-gap", where=f"lattice:{entry}",
+                message=f"serve-reachable lattice entry '{entry}' has no "
+                        "warm declaration (analysis.warm_check."
+                        "WARM_DECLARATIONS) — its first dispatch would "
+                        "compile inside the serving window; add a warm "
+                        "path through the dispatch seam and declare it",
+                pass_name="lattice"))
+    for entry, (rel, warm_fn, seam) in sorted(WARM_DECLARATIONS.items()):
+        mod = graph_for(rel)
+        where = f"{rel}:{warm_fn}"
+        if mod is None:
+            out.append(Finding(
+                rule="warm-gap", where=where,
+                message=f"warm declaration for '{entry}' names module "
+                        f"'{rel}', which does not exist",
+                pass_name="lattice"))
+            continue
+        if warm_fn not in mod.defs:
+            out.append(Finding(
+                rule="warm-gap", where=where,
+                message=f"warm declaration for '{entry}' names "
+                        f"'{warm_fn}', not defined in {rel} — the warm "
+                        "path was renamed or removed without updating "
+                        "the coverage proof", pass_name="lattice"))
+            continue
+        if seam is not None and not mod.reaches(warm_fn, seam):
+            out.append(Finding(
+                rule="warm-gap", where=where,
+                message=f"'{warm_fn}' no longer reaches the dispatch "
+                        f"seam '{seam}' — it warms a lookalike, not the "
+                        f"executable serving dispatches for '{entry}'",
+                pass_name="lattice"))
+    return out
+
+
+def _fixture_literal(tree: ast.Module) -> "tuple[dict, int] | None":
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "GRAFT_LATTICE"):
+            try:
+                return ast.literal_eval(node.value), node.lineno
+            except ValueError:
+                return None
+    return None
+
+
+def _check_fixture_tree(base: Path) -> list[Finding]:
+    out: list[Finding] = []
+    for path in sorted(base.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        source = path.read_text()
+        if "GRAFT_LATTICE" not in source:
+            continue
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            continue
+        lit = _fixture_literal(tree)
+        if lit is None:
+            continue
+        decl, lineno = lit
+        rel = path.relative_to(base).as_posix()
+        waivers = _comment_waivers(source)
+        defined = {n.name for n in ast.walk(tree)
+                   if isinstance(n, ast.FunctionDef)}
+        reachable = list(decl.get("reachable", ()))
+        declared = list(decl.get("declared", ()))
+        warm = dict(decl.get("warm", {}))
+
+        def hit(rule: str, message: str) -> None:
+            waived, reason = False, ""
+            for ln in (lineno, lineno - 1):
+                w = waivers.get(ln)
+                if w and (rule in w[0] or "all" in w[0]):
+                    waived, reason = True, w[1]
+                    break
+            out.append(Finding(
+                rule=rule, where=f"{rel}:{lineno}", message=message,
+                pass_name="lattice", waived=waived, waiver_reason=reason))
+
+        for entry in reachable:
+            if entry not in warm:
+                hit("warm-gap",
+                    f"reachable entry '{entry}' has no warm declaration")
+            elif warm[entry] not in defined:
+                hit("warm-gap",
+                    f"warm declaration for '{entry}' names "
+                    f"'{warm[entry]}', not defined in this module")
+        for entry in declared:
+            if entry not in reachable:
+                hit("lattice-unreachable",
+                    f"declared entry '{entry}' is not reachable")
+    return out
+
+
+def run_warm_check(root: "Path | str | None" = None) -> Report:
+    """Real tree (root=None): verify WARM_DECLARATIONS against the
+    installed package and fold in dead-tier detection. Fixture tree:
+    evaluate ``GRAFT_LATTICE`` literals."""
+    report = Report()
+    if root is None:
+        report.findings.extend(_check_real_tree(package_root()))
+        report.findings.extend(check_unreachable())
+    else:
+        report.findings.extend(_check_fixture_tree(Path(root)))
+    return report

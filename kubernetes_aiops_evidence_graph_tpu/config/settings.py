@@ -17,6 +17,8 @@ from dataclasses import dataclass, field, fields
 from functools import lru_cache
 from typing import Any
 
+from ..analysis import ladders as _ladders
+
 
 def _parse_buckets(raw: str) -> tuple[int, ...]:
     """Parse a bucket ladder from env: positive ints, sorted ascending."""
@@ -168,8 +170,9 @@ class Settings:
     # ceiling is ops.pallas_segment._VMEM_HARD_LIMIT)
     vmem_budget_bytes: int = 8 * 2 ** 20
     # node rows per DMA staging block in the embed/update streams
-    # (power of two; clamped to the node bucket)
-    gnn_dma_node_block: int = 2048
+    # (power of two; clamped to the node bucket — quantum declared in
+    # analysis/ladders.py, aligned against every node rung there)
+    gnn_dma_node_block: int = _ladders.DMA_NODE_BLOCK
     # quantized node-feature table for the DMA tick: "" = f32,
     # "bfloat16" = bf16 table, "int8" = per-column-scale symmetric int8
     # (quantize_features). Tolerance-gated, forces the DMA tier.
@@ -385,11 +388,11 @@ class Settings:
     # territory (the resident fused tick refuses them — see
     # ops.pallas_segment.fused_tick_vmem_bytes). Existing rungs are
     # untouched so every previously-chosen static shape stays identical.
-    node_bucket_sizes: tuple = (256, 1024, 4096, 16384, 65536,
-                                262144, 524288)
-    edge_bucket_sizes: tuple = (1024, 4096, 16384, 65536, 262144,
-                                1048576, 4194304)
-    incident_bucket_sizes: tuple = (8, 32, 128, 512)
+    # (rungs declared in analysis/ladders.py — graft-lattice — where the
+    # ladder-gap check pins 500k-pod coverage and the DMA block alignment)
+    node_bucket_sizes: tuple = _ladders.NODE_BUCKET_SIZES
+    edge_bucket_sizes: tuple = _ladders.EDGE_BUCKET_SIZES
+    incident_bucket_sizes: tuple = _ladders.INCIDENT_BUCKET_SIZES
     # NOTE: there is deliberately no pallas flag — the fused rules kernel
     # measured at parity with the XLA path at config 3 (both ~0.2 ms/pass
     # on v5e-1) and lives in experiments/pallas_rules.py until it wins

@@ -46,9 +46,12 @@ from .store import EvidenceGraphStore, _Node
 # anchored at _REL_SLICE_STEP above the ladder, so EVERY count rounds to
 # the same capacity as before the stretch — no static offset tuple, jit
 # cache key or cost baseline shifts.
-REL_SLICE_BUCKETS = (64, 128, 256, 512, 1024, 2048, 4096, 8192,
-                     16384, 24576, 32768)
-_REL_SLICE_STEP = 8192
+# graft-lattice: the rungs and step live in the declared ladder
+# registry (analysis/ladders.py); these aliases keep the historical
+# import surface (build_snapshot, parallel/partition.py, the streaming
+# edge mirror) pointing at the one source of truth
+from ..analysis.ladders import (REL_SLICE_BUCKETS,
+                                REL_SLICE_STEP as _REL_SLICE_STEP)
 
 
 def rel_slice_offsets(counts, slack: float = 0.0,

@@ -122,8 +122,11 @@ from jax.experimental.pallas import tpu as pltpu
 # (powers of two >= 64, then 8192-multiples — graph/snapshot.py), so tiles
 # never straddle a relation slice and the per-tile relation id is a static
 # table. [64, H] keeps the MXU tile busy at H = 64 while the gather loop —
-# the true bottleneck — stays row-granular either way.
-EDGE_TILE = 64
+# the true bottleneck — stays row-granular either way. The value lives in
+# the declared ladder registry (analysis/ladders.py), where the
+# ladder-divisibility check pins that it divides every rel-slice rung AND
+# the above-ladder rounding step.
+from ..analysis.ladders import EDGE_TILE
 
 
 @lru_cache(maxsize=64)

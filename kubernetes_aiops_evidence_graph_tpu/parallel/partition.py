@@ -20,6 +20,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ..analysis.ladders import INCIDENT_BUCKET_SIZES
 from ..graph.schema import RelationKind
 from ..graph.snapshot import GraphSnapshot, rel_slice_offsets
 from ..utils.padding import bucket_for
@@ -100,7 +101,7 @@ def partition_snapshot(
 
     pi = snapshot.padded_incidents
     per_dp = -(-pi // dp)
-    per_dp = bucket_for(per_dp, (8, 32, 128, 512))
+    per_dp = bucket_for(per_dp, INCIDENT_BUCKET_SIZES)
     inc_nodes = np.zeros((dp, per_dp), np.int32)
     inc_mask = np.zeros((dp, per_dp), np.float32)
     lab = np.zeros((dp, per_dp), np.int32)

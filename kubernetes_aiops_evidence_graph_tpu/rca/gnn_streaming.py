@@ -53,6 +53,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..analysis import ladders as _ladders
 from ..graph.store import EvidenceGraphStore
 from ..observability import get_logger
 from ..observability import metrics as obs_metrics
@@ -265,7 +266,8 @@ class GnnStreamingScorer(StreamingScorer):
         self._use_dma = bool(getattr(cfg, "gnn_tick_dma", False))
         self._vmem_budget = int(getattr(cfg, "vmem_budget_bytes",
                                         8 * 2 ** 20))
-        self._dma_node_block = int(getattr(cfg, "gnn_dma_node_block", 2048))
+        self._dma_node_block = int(getattr(cfg, "gnn_dma_node_block",
+                                           _ladders.DMA_NODE_BLOCK))
         self._feat_quant = str(getattr(cfg, "gnn_feature_quant", "") or "")
         # persistent DMA activation ping-pong scratch (donated + rebound
         # every DMA tick — content is pure per-tick scratch, fully

@@ -47,9 +47,13 @@ from .tpu_backend import _PAIR_WIDTH_BUCKETS, _WIDTH_BUCKETS
 
 # graft-tide appended the 65536 rung for 500k-pod churn bursts (the
 # coalesced-tick registry entry keys its canonical shape off the top
-# rung, so its cost baseline was re-derived with the stretch)
-_DELTA_BUCKETS = (64, 256, 1024, 4096, 16384, 65536)
-_ROW_BUCKETS = (4, 16, 64, 256)
+# rung, so its cost baseline was re-derived with the stretch).
+# graft-lattice: the rungs now live in the declared ladder registry
+# (analysis/ladders.py) — one source of truth for serving, bench and
+# the ladder-gap/divisibility checks; the aliases keep every existing
+# import site working.
+from ..analysis.ladders import (DELTA_BUCKETS as _DELTA_BUCKETS,
+                                ROW_BUCKETS as _ROW_BUCKETS)
 
 _NO_PAIR = -1          # host-side "evidence has no scheduled node" marker
 
@@ -1358,9 +1362,13 @@ class StreamingScorer:
                         # _delta_pack before the tick — pre-compile its
                         # (li, pk, dim) variant too, or the first real
                         # tick at this combo pays the compile mid-serve
+                        # gi=0 passed EXPLICITLY: pjit keys its cache on
+                        # the static kwargs as passed, so a defaulted gi
+                        # here would warm an entry the live dispatch
+                        # (which always passes gi=slab_gi) never hits
                         li = pk + 2 * rk + 2 * rk * width
                         _delta_pack(jnp.zeros(li + pk * dim, jnp.int32),
-                                    li=li, pk=pk, dim=dim)
+                                    li=li, pk=pk, dim=dim, gi=0)
                     for pw in {cur_w, next_w}:
                         # graft-audit: allow[lock-guard] cooperative-cancel fast path: a stale read only delays the stop by one warm compile step
                         if self._warm_stop:
@@ -1504,7 +1512,7 @@ class StreamingScorer:
                             li = pk + 2 * rk + 2 * rk * width
                             _delta_pack(
                                 jnp.zeros(li + pk * dim, jnp.int32),
-                                li=li, pk=pk, dim=dim)
+                                li=li, pk=pk, dim=dim, gi=0)
                     self._tick_fn(cpn, cpi, width, pw, pk=pk, rk=rk)(
                         feats, jnp.asarray(ints),
                         jnp.asarray(f_rows), *tables, chain)
@@ -1650,6 +1658,7 @@ class StreamingScorer:
                              self.width, self.pair_width,
                              pk=pk, rk=rk)
         if columnar:
+            # graft-audit: allow[retrace-unbounded-static] dim is the architecture-fixed feature width (graph.schema.DIM, invariant across rebuilds), not a churn-driven count — reading it off the resident table keeps the pack aligned with whatever snapshot is live
             packed = _delta_pack(
                 jnp.asarray(slab), li=slab_li, pk=pk,
                 dim=self.snapshot.features.shape[1], gi=slab_gi)
@@ -1855,6 +1864,7 @@ class StreamingScorer:
             pi = self.snapshot.padded_incidents
             dim = self.snapshot.features.shape[1]
             width, pw = self.width, self.pair_width
+            columnar = isinstance(self._pending_feat, FeatureStage)
         g = (mesh.shape["graph"]
              if mesh is not None and "graph" in mesh.axis_names else 1)
         if g > 1 and pn % g:
@@ -1908,6 +1918,17 @@ class StreamingScorer:
                          jax.device_put(
                             jnp.zeros((pi,), jnp.float32), r1))
                 else:
+                    if columnar:
+                        # the unsharded columnar dispatch runs _delta_pack
+                        # before the tick, and warm() skips that combo
+                        # while the scorer is still graph-sharded — warm
+                        # it here or the first post-heal sync pays its
+                        # compile inside the recovery window
+                        # gi=0 explicit for the same pjit static-kwargs
+                        # cache-keying reason as warm()
+                        li = pk + 2 * rk + 2 * rk * width
+                        _delta_pack(jnp.zeros(li + pk * dim, jnp.int32),
+                                    li=li, pk=pk, dim=dim, gi=0)
                     ints = _pack_ints(
                         np.full(pk, pn, np.int32),
                         np.full(rk, pi, np.int32), np.zeros(rk, np.int32),

@@ -51,9 +51,13 @@ from .ruleset import (
     UNKNOWN_FINAL_SCORE,
 )
 
-_EDGE_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144)
-# width buckets for the dense per-incident evidence slot table
-_WIDTH_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192, 16384)
+# graft-lattice: rungs live in the declared ladder registry
+# (analysis/ladders.py); the historical private aliases stay the
+# import surface for the rest of the tree
+from ..analysis.ladders import (EDGE_BUCKETS as _EDGE_BUCKETS,
+                                PACK_BUCKETS as _PACK_BUCKETS_LADDER,
+                                PAIR_WIDTH_BUCKETS as _PAIR_WIDTH_BUCKETS,
+                                WIDTH_BUCKETS as _WIDTH_BUCKETS)
 # chunk size for the W-axis fold: bounds the materialized [Pi, chunk, DIM]
 # intermediate so one evidence-heavy incident can't blow up HBM
 _FOLD_CHUNK = 256
@@ -149,9 +153,6 @@ def dense_evidence_table(ev_rows: np.ndarray, ev_dst: np.ndarray, pi: int,
     if len(lo.rows_s):
         ev_idx[lo.rows_s, lo.slots] = ev_dst[lo.order]
     return ev_idx, lo.cnt.astype(np.int32)
-
-
-_PAIR_WIDTH_BUCKETS = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 
 def pair_tables(snapshot: GraphSnapshot, ev_rows: np.ndarray,
@@ -513,7 +514,8 @@ class TpuRcaBackend:
     # static incident-bucket ladder for the packed cross-tenant pass
     # (graft-surge): the packed row count pads up this ladder so the
     # number of compiled variants stays discrete as tenant sets vary
-    _PACK_BUCKETS = (8, 32, 128, 512, 2048)
+    # (rungs declared in analysis/ladders.py — graft-lattice)
+    _PACK_BUCKETS = _PACK_BUCKETS_LADDER
 
     def score_snapshots(self, snapshots: "list[GraphSnapshot]",
                         fields: str = "top") -> list[dict]:

@@ -1,8 +1,10 @@
 #!/usr/bin/env bash
-# Fast pre-push audit loop: passes 2 (AST lint) and 4 (graft-sentinel)
-# only — both stdlib-only, no jax import, no jaxpr tracing — so the
-# whole repo checks in a couple of seconds. The full gate (jaxpr
-# invariants + cost ratchet) stays in CI:
+# Fast pre-push audit loop: passes 2 (AST lint), 4 (graft-sentinel) and
+# 5 (graft-lattice: ladder contracts, retrace lint, dispatch-lattice +
+# warm-coverage proof) — all stdlib-only, no jax import, no jaxpr
+# tracing — so the whole repo checks in a couple of seconds. The full
+# gate (jaxpr invariants + cost ratchet, and the runtime CompileFence
+# via KAEG_COMPILE_FENCE=1 in the chaos suites) stays in CI:
 #
 #   python -m kubernetes_aiops_evidence_graph_tpu.analysis [--cost]
 #

@@ -67,3 +67,23 @@ def _lock_order_guard():
     yield guard
     guard.uninstall()
     guard.assert_clean()
+
+
+# -- graft-lattice runtime half: post-warm compile fence ----------------------
+# Opt-in via KAEG_COMPILE_FENCE=1 (the chaos CI jobs export it next to the
+# lock guard): the session-wide fence hooks jax's backend-compile event and
+# stays DISARMED by default — suites that prove the zero-post-warm-compile
+# SLO arm it after their warm phase (see tests/test_graft_lattice.py), so
+# legitimate cold/warm compiles elsewhere in the session never count.
+
+@pytest.fixture(scope="session", autouse=True)
+def _compile_fence():
+    if os.environ.get("KAEG_COMPILE_FENCE") != "1":
+        yield None
+        return
+    from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+        CompileFence)
+    fence = CompileFence().install()
+    yield fence
+    fence.uninstall()
+    fence.assert_clean()

@@ -1,0 +1,12 @@
+"""Seeded violation: ladder rung that breaks its tiling quantum.
+
+The 64 rung is not a multiple of the declared 128-row tile (and the
+strict contract does not allow rungs below the quantum), so a kernel
+gridded at 128 rows straddles the capacity boundary. Exactly one
+ladder-divisibility.
+"""
+
+GRAFT_LADDERS = {
+    "slice": {"rungs": [64, 128], "max_gap_ratio": 2.0,
+              "escalation": "rebuild", "divisor": 128},
+}

@@ -1,0 +1,10 @@
+"""Seeded violation: ladder rung gap beyond the padding-inflation bound.
+
+64 -> 512 is an 8x jump: a live count of 65 pads to 512 — 7.9x its
+size — which the declared 4x bound rejects. Exactly one ladder-gap.
+"""
+
+GRAFT_LADDERS = {
+    "delta": {"rungs": [64, 512, 1024], "max_gap_ratio": 4.0,
+              "escalation": "rebuild"},
+}

@@ -1,0 +1,16 @@
+"""Seeded violation: a declared tick entry no settings reach.
+
+``tick.dead`` is declared but not in the reachable set — a dead tier
+that still costs audit/baseline maintenance. Exactly one
+lattice-unreachable.
+"""
+
+GRAFT_LATTICE = {
+    "reachable": ["tick.base"],
+    "declared": ["tick.base", "tick.dead"],
+    "warm": {"tick.base": "warm_base"},
+}
+
+
+def warm_base():
+    return None

@@ -1,0 +1,18 @@
+"""Seeded violation: raw size into a jit static argnum.
+
+``len(rows)`` reaches the static ``pk`` without a ladder quantizer, so
+the executable cache keys on the live row count — one compile per
+distinct value under churn. Exactly one retrace-unbounded-static.
+"""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("pk",))
+def fold(xs, pk: int):
+    return xs[:pk] * 2.0
+
+
+def serve(xs, rows):
+    return fold(xs, pk=len(rows))

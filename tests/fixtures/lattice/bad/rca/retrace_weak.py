@@ -1,0 +1,16 @@
+"""Seeded violation: weak-type scalar into a traced jit position.
+
+The bare ``0.97`` enters the trace as a weak-typed scalar; the same
+call with a committed-dtype array has a different aval, so mixing the
+two call styles retraces. Exactly one retrace-weak-type.
+"""
+import jax
+
+
+@jax.jit
+def decay(state, rate):
+    return state * rate
+
+
+def serve(state):
+    return decay(state, 0.97)

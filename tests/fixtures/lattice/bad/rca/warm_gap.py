@@ -1,0 +1,15 @@
+"""Seeded violation: a serve-reachable lattice entry with no warm path.
+
+``tick.fast`` is reachable but absent from the warm map, so its first
+dispatch compiles inside the serving window. Exactly one warm-gap.
+"""
+
+GRAFT_LATTICE = {
+    "reachable": ["tick.base", "tick.fast"],
+    "declared": ["tick.base", "tick.fast"],
+    "warm": {"tick.base": "warm_base"},
+}
+
+
+def warm_base():
+    return None

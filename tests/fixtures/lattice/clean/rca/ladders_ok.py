@@ -1,0 +1,11 @@
+"""Clean fixture: ladders that honor every declared contract —
+monotone rungs, bounded gaps, tile-aligned capacities, and either
+coverage or a declared above-ladder escalation."""
+
+GRAFT_LADDERS = {
+    "delta": {"rungs": [64, 256, 1024], "covers": 100000,
+              "escalation": "rebuild"},
+    "slice": {"rungs": [64, 128, 256], "max_gap_ratio": 2.0,
+              "covers": 4096, "escalation": "step", "step": 64,
+              "divisor": 64},
+}

@@ -1,0 +1,12 @@
+"""Clean fixture: every declared entry reachable, every reachable
+entry warm via a function defined in this module."""
+
+GRAFT_LATTICE = {
+    "reachable": ["tick.base", "tick.fast"],
+    "declared": ["tick.base", "tick.fast"],
+    "warm": {"tick.base": "warm_all", "tick.fast": "warm_all"},
+}
+
+
+def warm_all():
+    return None

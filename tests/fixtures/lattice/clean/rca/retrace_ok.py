@@ -1,0 +1,29 @@
+"""Clean fixture: the sanctioned call shapes — statics drawn from a
+ladder quantizer, scalars committed to a dtype before tracing."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.padding import bucket_for
+
+BUCKETS = (64, 256, 1024)
+
+
+@partial(jax.jit, static_argnames=("pk",))
+def fold(xs, pk: int):
+    return xs[:pk] * 2.0
+
+
+def serve(xs, rows):
+    pk = bucket_for(len(rows), BUCKETS)
+    return fold(xs, pk=pk)
+
+
+@jax.jit
+def decay(state, rate):
+    return state * rate
+
+
+def serve_decay(state):
+    return decay(state, jnp.asarray(0.97, jnp.float32))

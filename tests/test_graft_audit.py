@@ -143,7 +143,7 @@ def test_package_waiver_census_is_exact_and_every_reason_is_argued():
     from kubernetes_aiops_evidence_graph_tpu.analysis.sentinel import (
         collect_waivers)
     entries = collect_waivers()
-    assert len(entries) == 41, [e["where"] for e in entries]
+    assert len(entries) == 42, [e["where"] for e in entries]
     assert all(e["reason"] for e in entries)
     # the sentinel calibration waivers are the argued-race set: every
     # lock-guard waiver must actually argue its race

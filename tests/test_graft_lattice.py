@@ -1,0 +1,570 @@
+"""graft-lattice: pass-5 tests (marker ``static_audit``) + the fenced
+zero-post-warm-compile perf contract (marker ``perf_contract``).
+
+Five layers:
+
+* seeded-violation fixtures under tests/fixtures/lattice — each bad
+  file trips EXACTLY its rule (the clean tree none), the CLI exits
+  non-zero on the bad tree and honors ``--skip-lattice``;
+* the ladder registry — the real tree's declared ladders pass every
+  contract, each contract demonstrably bites on a tampered ladder, and
+  the dedupe is pinned by IDENTITY: the historical private names in
+  rca/streaming.py, rca/tpu_backend.py, graph/snapshot.py,
+  ops/pallas_segment.py, config/settings.py and analysis/registry.py
+  are the analysis/ladders.py objects, not copies that can drift;
+* retrace — the real tree is clean modulo the one argued waiver, and
+  stripping that waiver from a COPY of streaming.py is caught;
+* the dispatch lattice + warm proof — the enumeration matches the
+  registry exactly (no dead tiers, no uncovered entries), every warm
+  declaration verifies against the source, and renaming ``warm_gnn``
+  in a COPY of gnn_streaming.py trips ``warm-gap``;
+* the runtime half — :class:`CompileFence` unit semantics, then the
+  perf contract: for every serve-reachable lattice point (tier ×
+  quant × depth, plus the sharded mirror and an ``adopt_mesh`` heal)
+  the declared warm paths pre-compile everything a fenced churn window
+  — including a forced mid-script rebuild — will dispatch: zero
+  compiles inside the armed window, and the dispatcher's live
+  ``_scope_entry`` equals the statically enumerated entry (the mirror
+  that keeps ``resolve_entry`` honest).
+"""
+import json
+import shutil
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubernetes_aiops_evidence_graph_tpu.analysis import ladders
+from kubernetes_aiops_evidence_graph_tpu.analysis.__main__ import (
+    main as audit_main)
+from kubernetes_aiops_evidence_graph_tpu.analysis.ast_lint import (
+    package_root)
+from kubernetes_aiops_evidence_graph_tpu.analysis.dispatch_lattice import (
+    OFF_SERVE_VARIANTS, RUNG_AXIS_VARIANTS, check_unreachable,
+    enumerate_lattice, reachable_entries, resolve_entry)
+from kubernetes_aiops_evidence_graph_tpu.analysis.findings import RULES
+from kubernetes_aiops_evidence_graph_tpu.analysis.ladders import (
+    Ladder, check_ladder, run_ladders)
+from kubernetes_aiops_evidence_graph_tpu.analysis.retrace import run_retrace
+from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+    CompileFence, maybe_install_compile_fence)
+from kubernetes_aiops_evidence_graph_tpu.analysis.warm_check import (
+    WARM_DECLARATIONS, _check_real_tree, run_warm_check)
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+
+pytestmark = pytest.mark.static_audit
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lattice"
+
+# every seeded lattice fixture file and the ONE rule it must trip
+LATTICE_EXPECTED = {
+    "rca/ladder_gap.py": "ladder-gap",
+    "rca/ladder_div.py": "ladder-divisibility",
+    "rca/retrace_static.py": "retrace-unbounded-static",
+    "rca/retrace_weak.py": "retrace-weak-type",
+    "rca/warm_gap.py": "warm-gap",
+    "rca/lattice_unreachable.py": "lattice-unreachable",
+}
+
+LATTICE_RULES = {"ladder-gap", "ladder-divisibility",
+                 "retrace-unbounded-static", "retrace-weak-type",
+                 "warm-gap", "lattice-unreachable"}
+
+
+def _run_lattice(root):
+    out = run_ladders(root)
+    out.extend(run_retrace(root))
+    out.extend(run_warm_check(root))
+    return out
+
+
+# -- seeded fixtures -------------------------------------------------------
+
+def test_lattice_fixtures_each_produce_exactly_the_expected_finding():
+    report = _run_lattice(FIXTURES / "bad")
+    got = {(f.where.rsplit(":", 1)[0], f.rule) for f in report.violations}
+    assert got == set(LATTICE_EXPECTED.items())
+    assert len(report.violations) == len(LATTICE_EXPECTED)
+
+
+def test_lattice_clean_tree_has_no_findings_at_all():
+    report = _run_lattice(FIXTURES / "clean")
+    assert report.findings == []
+
+
+def test_cli_exits_nonzero_on_bad_tree_and_zero_on_clean(capsys):
+    assert audit_main(["--root", str(FIXTURES / "bad")]) == 1
+    assert audit_main(["--root", str(FIXTURES / "clean")]) == 0
+    capsys.readouterr()
+
+
+def test_skip_lattice_flag_suppresses_the_pass(capsys):
+    assert audit_main(["--root", str(FIXTURES / "bad"),
+                       "--skip-lattice"]) == 0
+    capsys.readouterr()
+
+
+def test_lattice_rules_are_in_the_canonical_table(capsys):
+    for rule in LATTICE_RULES:
+        assert RULES[rule][0] == "lattice", rule
+        assert RULES[rule][1]
+    rc = audit_main(["--root", str(FIXTURES / "clean"), "--report", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert LATTICE_RULES <= set(out["rules"])
+
+
+# -- ladder registry -------------------------------------------------------
+
+def test_real_tree_ladders_pass_every_contract():
+    report = run_ladders()
+    assert report.findings == [], report.to_text()
+    # the registry actually covers the tree: every historical ladder name
+    names = {lad.name for lad in ladders.LADDERS}
+    assert {"delta", "row", "edge", "width", "pair_width", "pack",
+            "rel_slice", "node", "edge_snapshot", "incident"} == names
+
+
+@pytest.mark.parametrize("spec,rule", [
+    (dict(rungs=(64, 32)), "ladder-gap"),                 # non-monotone
+    (dict(rungs=(64, 640)), "ladder-gap"),                # 10x gap
+    (dict(rungs=(64,), covers=500), "ladder-gap"),        # ends below scale
+    (dict(rungs=(64,), covers=500, escalation="step"),
+     "ladder-gap"),                                       # step with no step
+    (dict(rungs=(48, 96), divisor=32), "ladder-divisibility"),
+    (dict(rungs=(64, 128), divisor=64, escalation="step",
+          covers=500, step=96), "ladder-divisibility"),   # step misaligned
+])
+def test_each_ladder_contract_bites(spec, rule):
+    lad = Ladder(name="t", defined_in="t.py:T", **spec)
+    findings = check_ladder(lad, "t.py:T")
+    assert findings and {f.rule for f in findings} == {rule}
+
+
+def test_divisor_min_uses_the_dma_alignment_rule():
+    """node-ladder semantics: rungs below the block must divide it,
+    rungs at/above must be block multiples (pn % min(block, pn) == 0)."""
+    ok = Ladder("n", (256, 1024, 2048, 4096), "t.py:N", divisor=2048,
+                divisor_min=True)
+    assert check_ladder(ok, "x") == []
+    bad = Ladder("n", (768, 2048), "t.py:N", divisor=2048,
+                 divisor_min=True)
+    assert {f.rule for f in check_ladder(bad, "x")} == {
+        "ladder-divisibility"}
+
+
+def test_ladder_dedupe_is_identity_not_equality():
+    """Satellite 1 drift guard: the consuming modules must hold the
+    ladders.py OBJECTS — a re-declared copy (even value-equal today)
+    re-opens one-sided drift."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis import registry
+    from kubernetes_aiops_evidence_graph_tpu.graph import snapshot
+    from kubernetes_aiops_evidence_graph_tpu.ops import pallas_segment
+    from kubernetes_aiops_evidence_graph_tpu.rca import streaming
+    from kubernetes_aiops_evidence_graph_tpu.rca import tpu_backend
+    assert streaming._DELTA_BUCKETS is ladders.DELTA_BUCKETS
+    assert streaming._ROW_BUCKETS is ladders.ROW_BUCKETS
+    assert tpu_backend._EDGE_BUCKETS is ladders.EDGE_BUCKETS
+    assert tpu_backend._WIDTH_BUCKETS is ladders.WIDTH_BUCKETS
+    assert tpu_backend._PAIR_WIDTH_BUCKETS is ladders.PAIR_WIDTH_BUCKETS
+    assert tpu_backend.TpuRcaBackend._PACK_BUCKETS is ladders.PACK_BUCKETS
+    assert snapshot.REL_SLICE_BUCKETS is ladders.REL_SLICE_BUCKETS
+    assert snapshot._REL_SLICE_STEP == ladders.REL_SLICE_STEP
+    assert pallas_segment.EDGE_TILE == ladders.EDGE_TILE
+    assert registry.DMA_NODE_BLOCK == ladders.DMA_NODE_BLOCK
+    cfg = load_settings()
+    assert cfg.node_bucket_sizes is ladders.NODE_BUCKET_SIZES
+    assert cfg.edge_bucket_sizes is ladders.EDGE_BUCKET_SIZES
+    assert cfg.incident_bucket_sizes is ladders.INCIDENT_BUCKET_SIZES
+    assert cfg.gnn_dma_node_block == ladders.DMA_NODE_BLOCK
+
+
+# -- retrace ---------------------------------------------------------------
+
+def _copy_into(tmp_path: Path, rel: str) -> Path:
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(package_root() / rel, dst)
+    return dst
+
+
+def test_repo_self_audit_is_retrace_clean_with_the_argued_waiver():
+    report = run_retrace()
+    assert report.violations == [], report.to_text()
+    waived = {(f.rule, f.where.rsplit(":", 1)[0]) for f in report.waivers}
+    assert ("retrace-unbounded-static", "rca/streaming.py") in waived
+
+
+def test_stripping_the_streaming_waiver_is_caught(tmp_path):
+    """The columnar _delta_pack call reads dim off the resident table —
+    waived with a reason. Removing the pragma (or re-introducing the
+    shape-into-static pattern anywhere) must be flagged."""
+    dst = _copy_into(tmp_path, "rca/streaming.py")
+    assert run_retrace(tmp_path).violations == []   # faithful copy: clean
+    src = dst.read_text()
+    assert "allow[retrace-unbounded-static]" in src
+    dst.write_text("\n".join(
+        ln for ln in src.splitlines()
+        if "allow[retrace-unbounded-static]" not in ln) + "\n")
+    violations = run_retrace(tmp_path).violations
+    assert {f.rule for f in violations} == {"retrace-unbounded-static"}
+
+
+def test_retrace_flags_a_seeded_weak_type_mutation(tmp_path):
+    """Appending a literal-operand call of a declared jitted entrypoint
+    to a COPY of streaming.py trips retrace-weak-type."""
+    dst = _copy_into(tmp_path, "rca/streaming.py")
+    dst.write_text(dst.read_text() + """
+
+def _lattice_probe(features, ints, f_rows, ev_idx, ev_cnt, ev_pair):
+    return _tick(features, ints, f_rows, ev_idx, ev_cnt, ev_pair, 0.5,
+                 padded_incidents=8, pair_width=4, pk=4, rk=4, width=4)
+""")
+    violations = run_retrace(tmp_path).violations
+    assert {f.rule for f in violations} == {"retrace-weak-type"}
+
+
+# -- dispatch lattice + warm proof -----------------------------------------
+
+def test_lattice_enumeration_matches_the_registry_exactly():
+    """Closure both ways: every reachable entry is declared in the
+    registry, and every declared tick entry is reachable (or an
+    explicitly documented off-serve variant / rung-axis alias)."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import (
+        ENTRYPOINTS)
+    declared = {e.name for e in ENTRYPOINTS
+                if e.name.startswith(("streaming.", "ingest."))}
+    reachable = reachable_entries()
+    assert reachable <= declared, reachable - declared
+    assert check_unreachable() == []
+    accounted = (reachable | set(RUNG_AXIS_VARIANTS)
+                 | set(OFF_SERVE_VARIANTS))
+    assert declared <= accounted, declared - accounted
+
+
+def test_every_reachable_entry_has_a_warm_declaration():
+    covered = set(WARM_DECLARATIONS) | set(OFF_SERVE_VARIANTS)
+    missing = reachable_entries() - covered
+    assert missing == set(), missing
+    report = run_warm_check()
+    assert report.findings == [], report.to_text()
+
+
+def test_resolve_entry_mirrors_the_gate_chain():
+    """Spot-check the static mirror of _dma_ok/_fused_ok/_tick_entrypoint
+    at the gate boundaries."""
+    base = dict(bucketed=True, pallas=False, fused=False, dma=False,
+                compute=None, quant="", sharded=False, vmem_over=False)
+    assert resolve_entry(**base) == ("streaming.gnn_tick.bucketed", "xla")
+    # quant without the DMA tier never serves
+    assert resolve_entry(**{**base, "quant": "int8"}) is None
+    # the sharded mirror wins over every tier gate
+    assert resolve_entry(**{**base, "sharded": True, "dma": True,
+                            "fused": True, "vmem_over": True}) \
+        == ("streaming.gnn_tick.sharded", "sharded")
+    # dma needs quant OR vmem pressure; otherwise falls through to fused
+    assert resolve_entry(**{**base, "dma": True, "fused": True}) \
+        == ("streaming.gnn_tick.fused", "fused")
+    assert resolve_entry(**{**base, "dma": True, "vmem_over": True}) \
+        == ("streaming.gnn_tick.dma", "dma")
+    assert resolve_entry(**{**base, "dma": True, "quant": "bfloat16"}) \
+        == ("streaming.gnn_tick.dma.bf16", "dma")
+    # a bf16-compute fused tick is its own executable identity
+    assert resolve_entry(**{**base, "fused": True,
+                            "compute": "bfloat16"}) \
+        == ("streaming.gnn_tick.fused.bf16", "fused")
+    # un-bucketed parity path
+    assert resolve_entry(**{**base, "bucketed": False}) \
+        == ("streaming.gnn_tick", "xla")
+
+
+def test_lattice_points_carry_every_axis():
+    pts = enumerate_lattice()
+    assert {p.entry for p in pts} == reachable_entries()
+    assert {p.shards for p in pts} == {1, 2}
+    assert {p.depth for p in pts} == {1, 2}
+    assert {p.quant for p in pts} == {"", "bfloat16", "int8"}
+    assert {p.tier for p in pts} == {"xla", "pallas", "fused", "dma",
+                                     "sharded"}
+    assert all(p.label for p in pts)
+
+
+def test_renaming_a_warm_path_is_caught(tmp_path):
+    """The warm proof must verify against SOURCE, not trust the
+    declaration table: renaming warm_gnn in a copy trips warm-gap."""
+    for rel in ("rca/streaming.py", "rca/gnn_streaming.py",
+                "rca/surge.py"):
+        _copy_into(tmp_path, rel)
+    assert _check_real_tree(tmp_path) == []   # faithful copies: clean
+    dst = tmp_path / "rca/gnn_streaming.py"
+    dst.write_text(dst.read_text().replace("def warm_gnn(",
+                                           "def warm_gnn_renamed(", 1))
+    findings = _check_real_tree(tmp_path)
+    assert findings and {f.rule for f in findings} == {"warm-gap"}
+    assert any("warm_gnn" in f.message for f in findings)
+
+
+def test_severing_the_dispatch_seam_is_caught(tmp_path):
+    """A warm path that stops going through the serve seam warms a
+    lookalike — the seam-reachability check must notice."""
+    for rel in ("rca/streaming.py", "rca/gnn_streaming.py",
+                "rca/surge.py"):
+        _copy_into(tmp_path, rel)
+    dst = tmp_path / "rca/gnn_streaming.py"
+    dst.write_text(dst.read_text().replace("self._call_gnn_tick(",
+                                           "self._call_gnn_tick_v2("))
+    findings = _check_real_tree(tmp_path)
+    assert findings and {f.rule for f in findings} == {"warm-gap"}
+    assert any("_call_gnn_tick" in f.message for f in findings)
+
+
+# -- runtime half: CompileFence --------------------------------------------
+
+def test_compile_fence_charges_only_armed_window_compiles():
+    fence = CompileFence().install()
+    try:
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        f(jnp.ones(8)).block_until_ready()      # cold, disarmed: free
+        fence.arm()
+        f(jnp.ones(8)).block_until_ready()      # cache hit: free
+        assert fence.violations == []
+        with fence.region("lattice:probe"):
+            f(jnp.ones(16)).block_until_ready()  # fresh shape: charged
+        assert fence.violations
+        assert {v["region"] for v in fence.violations} == {"lattice:probe"}
+        with pytest.raises(AssertionError, match="post-warm compile"):
+            fence.assert_clean()
+        n = len(fence.violations)
+        fence.disarm()
+        f(jnp.ones(32)).block_until_ready()      # disarmed: free
+        assert len(fence.violations) == n
+    finally:
+        fence.uninstall()
+    f(jnp.ones(64)).block_until_ready()          # uninstalled: free
+    assert len(fence.violations) == n
+
+
+def test_compile_fence_unattributed_compiles_are_labeled():
+    fence = CompileFence().install()
+    try:
+        @jax.jit
+        def g(x):
+            return x + 3
+
+        fence.arm()
+        g(jnp.ones(7)).block_until_ready()       # no region on the stack
+        assert fence.violations
+        assert {v["region"] for v in fence.violations} == {
+            "<unattributed>"}
+    finally:
+        fence.uninstall()
+
+
+def test_compile_fence_env_opt_in(monkeypatch):
+    monkeypatch.delenv(CompileFence.ENV, raising=False)
+    assert maybe_install_compile_fence() is None
+    monkeypatch.setenv(CompileFence.ENV, "1")
+    fence = maybe_install_compile_fence()
+    try:
+        assert fence is not None
+        assert not fence._armed      # installs disarmed: suites arm
+    finally:
+        fence.uninstall()
+
+
+# -- the fenced perf contract ----------------------------------------------
+
+_BUCKETS = dict(node_bucket_sizes=(512, 2048),
+                edge_bucket_sizes=(2048, 8192),
+                incident_bucket_sizes=(8, 32))
+
+# one sweep leg per serve-reachable single-device lattice entry:
+# (label, settings overrides, pipeline depth, expected _scope_entry)
+_SWEEP = [
+    ("xla-f32-d1", dict(), 1, "streaming.gnn_tick.bucketed"),
+    ("pallas-f32-d2", dict(gnn_pallas=True), 2,
+     "streaming.gnn_tick.bucketed"),
+    ("fused-f32-d1", dict(gnn_fused_tick=True), 1,
+     "streaming.gnn_tick.fused"),
+    ("fused-bf16-d2", dict(gnn_fused_tick=True,
+                           gnn_compute_dtype="bfloat16"), 2,
+     "streaming.gnn_tick.fused.bf16"),
+    ("dma-f32-d1", dict(gnn_tick_dma=True, vmem_budget_bytes=1,
+                        gnn_dma_node_block=64), 1,
+     "streaming.gnn_tick.dma"),
+    ("dma-bf16-d2", dict(gnn_tick_dma=True, gnn_feature_quant="bfloat16",
+                         gnn_dma_node_block=64), 2,
+     "streaming.gnn_tick.dma.bf16"),
+    ("dma-int8-d1", dict(gnn_tick_dma=True, gnn_feature_quant="int8",
+                         gnn_dma_node_block=64), 1,
+     "streaming.gnn_tick.dma.int8"),
+]
+
+
+@pytest.fixture(scope="module")
+def shipped_params():
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_backend import (
+        _shipped_checkpoint)
+    from kubernetes_aiops_evidence_graph_tpu.rca.train import (
+        load_checkpoint)
+    return load_checkpoint(_shipped_checkpoint())["params"]
+
+
+def _world(settings, seed=13, num_pods=100):
+    from kubernetes_aiops_evidence_graph_tpu.collectors import (
+        collect_all, default_collectors)
+    from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+    from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+        sync_topology)
+    from kubernetes_aiops_evidence_graph_tpu.simulator import (
+        generate_cluster, inject)
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    injected = []
+    for i, name in enumerate(("crashloop_deploy", "oom")):
+        inc = inject(cluster, name, keys[i * 5 % len(keys)], rng)
+        injected.append(inc)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, settings), parallel=False))
+    return cluster, builder, injected
+
+
+def _fenced_churn(sc, fence, label, cluster, builder, injected,
+                  rebuild=True, heal_mesh="no"):
+    """Cold phase (warm paths + one served cycle, fence disarmed), then
+    an ARMED steady-state window: churn batches, a forced mid-script
+    rebuild, optionally an adopt_mesh heal, and a final rescore. Any
+    compile inside the window fails the fence."""
+    from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+        churn_events, store_step)
+    stream = list(churn_events(
+        cluster, 60, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    # -- cold phase: the DECLARED warm paths + one served cycle --------
+    sc.warm(delta_sizes=(64, 256), row_sizes=(4, 16))
+    if hasattr(sc, "warm_gnn"):
+        sc.warm_gnn(delta_sizes=(64, 256), edge_sizes=(64, 256, 1024))
+    sc.warm_growth()
+    for ev in stream[:20]:
+        store_step(cluster, builder.store, ev)
+    sc.sync()
+    sc.tick_async()
+    sc.rescore()
+    if heal_mesh != "no":
+        # production heal model: the classification window elapses N
+        # failures before the heal fires — warm_mesh pre-compiles the
+        # survivor-placement variants in that window (bench discipline)
+        sc.warm_mesh(heal_mesh, delta_sizes=(64, 256), row_sizes=(4, 16))
+    # -- armed window: steady-state serving must be compile-free -------
+    fence.arm()
+    try:
+        with fence.region(f"lattice:{label}"):
+            for s in range(20, len(stream), 20):
+                for ev in stream[s:s + 20]:
+                    store_step(cluster, builder.store, ev)
+                sc.sync()
+                sc.tick_async()
+            if rebuild:
+                sc._rebuild()
+                sc.sync()
+                sc.tick_async()
+            if heal_mesh != "no":
+                sc.adopt_mesh(heal_mesh)
+                sc.sync()
+                sc.tick_async()
+            out = sc.rescore()
+    finally:
+        fence.disarm()
+    fence.assert_clean()
+    return out
+
+
+@pytest.mark.perf_contract
+@pytest.mark.parametrize("label,over,depth,entry",
+                         _SWEEP, ids=[s[0] for s in _SWEEP])
+def test_zero_post_warm_compiles_across_the_lattice(
+        label, over, depth, entry, shipped_params):
+    """The SLO, observed: for every single-device lattice point the
+    declared warm paths pre-compile everything a churned serving window
+    (with a forced mid-script rebuild) dispatches — zero compiles
+    inside the armed fence — and the live dispatcher resolves exactly
+    the entry the static lattice enumerated."""
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+        GnnStreamingScorer)
+    cfg = load_settings(serve_pipeline_depth=depth, **_BUCKETS, **over)
+    cluster, builder, injected = _world(cfg)
+    sc = GnnStreamingScorer(builder.store, cfg, params=shipped_params,
+                            now_s=cluster.now.timestamp())
+    fence = CompileFence().install()
+    try:
+        out = _fenced_churn(sc, fence, label, cluster, builder, injected)
+    finally:
+        fence.uninstall()
+    assert out["incident_ids"], "premise: nothing served"
+    assert sc._scope_entry == entry, \
+        f"dispatcher resolved {sc._scope_entry}, lattice enumerated {entry}"
+    assert entry in reachable_entries()
+
+
+@pytest.mark.perf_contract
+def test_zero_post_warm_compiles_sharded_mirror(shipped_params):
+    """The D=2 sharded lattice point, same fenced protocol."""
+    from kubernetes_aiops_evidence_graph_tpu.parallel.mesh import (
+        ensure_host_devices)
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+        GnnStreamingScorer)
+    if not ensure_host_devices(2):
+        pytest.skip("cannot force >= 2 host devices")
+    cfg = load_settings(serve_pipeline_depth=2, serve_graph_shards=2,
+                        **_BUCKETS)
+    cluster, builder, injected = _world(cfg)
+    sc = GnnStreamingScorer(builder.store, cfg, params=shipped_params,
+                            now_s=cluster.now.timestamp())
+    assert sc._mirror_sharded, "premise: mirror not graph-sharded"
+    fence = CompileFence().install()
+    try:
+        out = _fenced_churn(sc, fence, "sharded-d2", cluster, builder,
+                            injected)
+    finally:
+        fence.uninstall()
+    assert out["incident_ids"]
+    assert sc._scope_entry == "streaming.gnn_tick.sharded"
+
+
+@pytest.mark.perf_contract
+def test_zero_post_warm_compiles_through_an_adopt_mesh_heal():
+    """The heal leg: a D=2 rules-tick world loses its mesh and reshards
+    to single-device inside the armed window. warm_mesh pre-compiled
+    the survivor placement (the production classification window), so
+    the heal itself — supersede, re-derive, re-dispatch, rescore — is
+    compile-free."""
+    from kubernetes_aiops_evidence_graph_tpu.parallel.mesh import (
+        ensure_host_devices)
+    from kubernetes_aiops_evidence_graph_tpu.rca.streaming import (
+        StreamingScorer)
+    if not ensure_host_devices(2):
+        pytest.skip("cannot force >= 2 host devices")
+    cfg = load_settings(serve_pipeline_depth=2, serve_graph_shards=2,
+                        **_BUCKETS)
+    cluster, builder, injected = _world(cfg)
+    sc = StreamingScorer(builder.store, cfg,
+                         now_s=cluster.now.timestamp())
+    assert sc.mesh is not None, "premise: no serving mesh to lose"
+    fence = CompileFence().install()
+    try:
+        out = _fenced_churn(sc, fence, "heal-d2-to-1", cluster, builder,
+                            injected, rebuild=False, heal_mesh=None)
+    finally:
+        fence.uninstall()
+    assert out["incident_ids"]
+    assert sc.mesh is None           # healed onto the single-device path
+    assert sc._scope_entry == "streaming.rules_tick"
