@@ -73,6 +73,11 @@ RUNG_AXIS_VARIANTS = {
     # the multi-tenant pack is the rules tick at PACK_BUCKETS rungs with
     # per-tenant row offsets — pack-rung axis of the same executable
     "streaming.rules_tick.multitenant": "streaming.rules_tick",
+    # graft-swell: an elastic scale event re-lands the SAME sharded tick
+    # executable at the target shard count D' — shard-count rung of the
+    # sharded tier, pre-warmed through ElasticController.prewarm before
+    # shield.scale_mesh adopts the mesh
+    "streaming.rules_tick.elastic": "streaming.rules_tick.sharded",
 }
 
 # declared tiers that are reachable but NOT on the serve path (need an

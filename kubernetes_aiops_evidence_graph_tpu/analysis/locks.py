@@ -49,8 +49,17 @@ GUARDED_BY: dict[str, dict] = {
     # graft-heal bookkeeping: the exclusion set and heal generation move
     # only inside the scorer's serve_lock (mesh_heal / reexpand)
     "rca/shield.py": {
-        "locks": {"serve_lock": {"_mesh_excluded", "_heal_gen"}},
+        "locks": {"serve_lock": {"_mesh_excluded", "_heal_gen",
+                                 "_mesh_home"}},
         "held_fns": set(),
+    },
+    # graft-swell fleet state: tenant placement, per-tenant load EWMAs
+    # and the scale/migration history ring are mutated by migrate()/
+    # register() and read by the fleet API from HTTP threads
+    "rca/surge.py": {
+        "locks": {"_lock": {"_placement", "_loads", "_history"}},
+        "held_fns": {"_place_locked", "_tenants_of_locked",
+                     "_recover_placement", "_build_pack_locked"},
     },
     # warm re-arm machinery: the stop/re-arm flags are flipped from the
     # serve thread and read from the warm thread

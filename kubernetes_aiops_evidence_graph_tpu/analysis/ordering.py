@@ -60,11 +60,14 @@ ORDERED_SITES: dict[str, dict] = {
         "exempt": r"replay|recover|restore|reconcile|rebuild",
     },
     # the atomic multi-tenant swap journals each shielded tenant before
-    # installing the generation through its locked seam
+    # installing the generation through its locked seam; graft-swell
+    # migration likewise appends the fleet-WAL intent record before the
+    # source repack / destination adopt mutate either pack
     "rca/surge.py": {
         "rule": "wal-order",
         "journal": ("journal.append",),
-        "mutate": ("scorer._swap_params_locked",),
+        "mutate": ("scorer._swap_params_locked",
+                   "pack.remove_tenant", "pack.add_tenant"),
         "exempt": r"replay|recover|restore",
     },
     # intent-before-mutation (graft-saga): the executor writes the

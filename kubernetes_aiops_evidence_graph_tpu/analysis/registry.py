@@ -484,6 +484,39 @@ def _sharded_rules_tick_build():
     return fn, args
 
 
+# graft-swell: the shard count an elastic scale-UP re-lands the sharded
+# tick at — one rung up the divisor ladder from GRAPH_SHARDS, so the
+# audit proves the scale target's jaxpr obeys the same collective
+# contract as the base sharded tier (per-shard shapes shrink, the one
+# verdict psum stays byte-identical)
+ELASTIC_SHARDS = 4
+
+
+def _elastic_rules_tick_build():
+    """graft-swell: the SAME sharded rules tick executable at the
+    elastic scale target D'=ELASTIC_SHARDS — what ElasticController
+    pre-warms before shield.scale_mesh adopts the wider mesh."""
+    from ..parallel.mesh import serving_mesh
+    mesh = serving_mesh(ELASTIC_SHARDS)
+    if mesh is None:
+        raise SkipEntrypoint(
+            f"needs >= {ELASTIC_SHARDS} devices for the graph axis")
+    np = _np()
+    from ..graph.schema import DIM
+    from ..parallel.sharded_streaming import sharded_rules_tick
+    g = ELASTIC_SHARDS
+    pn, pi, width, pair_width = 4096, 32, 128, 16
+    pk, rk = 64, 4
+    fn = sharded_rules_tick(mesh, pn // g, pi, pair_width, pk, rk, width)
+    ints = np.zeros((g, pk + 2 * rk + 2 * rk * width), np.int32)
+    args = (np.zeros((pn, DIM), np.float32), ints,
+            np.zeros((g, pk, DIM), np.float32),
+            np.zeros((pi, width), np.int32), np.zeros(pi, np.int32),
+            np.full((pi, width), pair_width, np.int32),
+            np.zeros(pi, np.float32))
+    return fn, args
+
+
 # per-shard relation-slice capacities the sharded GNN streaming tick
 # traces with: the canonical REL_COUNTS split over the graph axis (edges
 # partition by dst owner), floored so every relation keeps a live slice
@@ -710,6 +743,15 @@ _SHARDED_RULES_TICK_COST = CostSpec(
     max_bytes_per_op={"psum": 32 * (48 + 16) * 4},
     max_total_bytes=32 * (48 + 16) * 4 + 1024,
 )
+# graft-swell: the elastic target inherits the sharded tier's contract
+# verbatim — the verdict psum is [pi, DIM+PW] regardless of D', so the
+# byte caps do not scale with the shard count
+_ELASTIC_RULES_TICK_COST = CostSpec(
+    expect_counts={"psum": 1, "ppermute": 0, "all_gather": 0},
+    forbid=("all_to_all", "reduce_scatter", "psum_scatter", "pshuffle"),
+    max_bytes_per_op={"psum": 32 * (48 + 16) * 4},
+    max_total_bytes=32 * (48 + 16) * 4 + 1024,
+)
 _SHARDED_GNN_TICK_COST = CostSpec(
     expect_counts={"ppermute": (LAYERS + 1) * GRAPH_SHARDS, "psum": 0,
                    "all_gather": 0},
@@ -878,6 +920,15 @@ ENTRYPOINTS: tuple[Entrypoint, ...] = (
               "ONE [rows, DIM+PW] psum — zero ppermutes, zero "
               "all-gathers; the ratchet pins halo traffic from day one",
         cost=_SHARDED_RULES_TICK_COST),
+    Entrypoint(
+        "streaming.rules_tick.elastic", _elastic_rules_tick_build, _TICK,
+        notes="graft-swell elastic scale target: the sharded rules tick "
+              "at D'=ELASTIC_SHARDS (one divisor-ladder rung up) — "
+              "per-shard shapes shrink, the single [rows, DIM+PW] "
+              "verdict psum stays byte-identical, zero ppermutes; "
+              "pre-warmed by ElasticController.prewarm so a live scale "
+              "event pays an upload, never a compile",
+        cost=_ELASTIC_RULES_TICK_COST),
     Entrypoint(
         "streaming.gnn_tick.sharded", _sharded_gnn_tick_build, _TICK,
         notes="graft-fleet mesh-resident GNN tick: per-shard edge "

@@ -63,6 +63,11 @@ WARM_DECLARATIONS: dict[str, tuple] = {
         ("rca/streaming.py", "warm_mesh", "sharded_rules_tick"),
     "streaming.rules_tick.multitenant":
         ("rca/surge.py", "_growth_warm_buckets", None),
+    # graft-swell: the elastic controller pre-compiles the target-shard
+    # tick through the scorer's warm_mesh seam BEFORE scale_mesh adopts
+    # the mesh, so a scale event pays an upload, never a compile
+    "streaming.rules_tick.elastic":
+        ("rca/elastic.py", "prewarm", "warm_mesh"),
     # every single-device GNN tier warms through the SAME dispatch seam
     # serving uses, so whichever tier the live settings select is the
     # one warm_gnn compiles — one declaration per tier keeps the proof

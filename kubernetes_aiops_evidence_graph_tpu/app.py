@@ -52,6 +52,7 @@ class AiopsApp:
         cluster: Any,
         settings: Settings | None = None,
         db: Database | None = None,
+        surge: Any = None,
     ) -> None:
         self.settings = settings or get_settings()
         configure(self.settings.log_level)
@@ -112,8 +113,13 @@ class AiopsApp:
                                    "persist_spill_cap", 4096)), 1))
         self._spill_lock = threading.Lock()
         self._storm_sample_counter = 0
+        # graft-swell: an optional shared SurgeServer fleet — the worker
+        # serves off its tenant's pack, and GET /api/v1/fleet exposes
+        # placement / load / scale+migration history
+        self.surge = surge
         self.worker = IncidentWorker(cluster, self.db, builder=self.builder,
-                                     settings=self.settings, dedup=self.dedup)
+                                     settings=self.settings, dedup=self.dedup,
+                                     surge=surge)
         # graft-evolve (learn/): the online learning loop, attached to the
         # worker's resident GNN scorer once serving resolves it. Built on
         # a background thread at start() — scorer construction tensorizes

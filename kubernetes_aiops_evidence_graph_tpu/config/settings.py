@@ -333,6 +333,37 @@ class Settings:
     # whole-state rebuild) instead of waiting for the nonfinite backstop
     # to catch a wrong verdict.
     mesh_attest: bool = True
+    # graft-swell (rca/elastic.py + multi-pack SurgeServer): load-driven
+    # elastic meshes.  The ElasticController consumes gauges graft-scope
+    # already exports (roofline achieved-bytes/s vs modeled ceiling,
+    # pipeline queue depth / stall seconds, admission shed-ratio EWMA) and
+    # drives hysteresis+dwell-gated D->D' scale decisions through the
+    # SAME WAL-journaled adopt_mesh seam graft-heal uses, so a scale
+    # event pays an upload, never a compile, and keeps bit-parity.
+    elastic_enabled: bool = False
+    # both directions must hold for dwell_s before a scale fires (the
+    # StormMode hysteresis pattern — no flapping on a transient spike).
+    elastic_dwell_s: float = 10.0
+    # scale UP when pipeline occupancy (inflight/depth) or shed EWMA
+    # exceeds these, or roofline achieved-bytes/s exceeds this fraction
+    # of the modeled ceiling; scale DOWN when all fall below the lows.
+    elastic_up_occupancy: float = 0.75
+    elastic_down_occupancy: float = 0.25
+    elastic_up_shed: float = 0.05
+    elastic_down_shed: float = 0.005
+    elastic_up_roofline: float = 0.85
+    elastic_down_roofline: float = 0.30
+    # cooldown between consecutive scale events (seconds).
+    elastic_cooldown_s: float = 30.0
+    # fleet bin-packing: max tenants per MultiTenantScorer pack and max
+    # packs.  swell_max_packs=1 preserves the single-pack PR-9 behavior.
+    swell_pack_tenants: int = 4
+    swell_max_packs: int = 1
+    # per-tenant admitted-rows/s load estimate smoothing.
+    swell_load_alpha: float = 0.2
+    # fleet-WAL path for placement/migration records; empty = in-memory
+    # (single-process: placement is trivially re-derivable at boot)
+    swell_journal_path: str = ""
     # graft-evolve (learn/): the online learning loop — production
     # verdicts (verification outcomes, operator HypothesisFeedback,
     # rule-confirmed verdicts) harvested into labeled episodes, a

@@ -464,6 +464,22 @@ class ApiHandler(BaseHTTPRequestHandler):
     def learning_status(self):
         self._json(200, self.app.learning_status())
 
+    # -- elastic fleet (graft-swell) ---------------------------------------
+
+    @route("GET", "/api/v1/fleet")
+    def fleet_status(self):
+        """Per-mesh tenant placement, per-tenant admitted-rows/s load
+        estimates, and the scale/migration history ring — the operator
+        surface of the elastic fleet (rca/surge.SurgeServer)."""
+        surge = getattr(self.app, "surge", None)
+        if surge is None:
+            self._json(200, {"enabled": False, "packs": {},
+                             "placement": {}, "loads": {},
+                             "history": [], "generation": 0,
+                             "migrations": 0})
+            return
+        self._json(200, {"enabled": True, **surge.fleet()})
+
     # -- traces (observability; new) --------------------------------------
 
     @route("GET", "/api/v1/traces")

@@ -356,11 +356,12 @@ SERVE_ABSORB_SYNC_DRAINS = REGISTRY.counter(
 # device ticks and defers device_get to the caller boundary.
 SERVE_PIPELINE_INFLIGHT = REGISTRY.gauge(
     "aiops_serve_pipeline_inflight",
-    "Dispatched-but-unfetched ticks in the serving pipeline")
+    "Dispatched-but-unfetched ticks in the serving pipeline, by pack "
+    "label (graft-swell: one series per serving mesh)")
 SERVE_PIPELINE_STALL_SECONDS = REGISTRY.counter(
     "aiops_serve_pipeline_stall_seconds_total",
     "Time blocked waiting for a pipeline slot after the coalescing bound "
-    "(top of the delta ladder) was reached")
+    "(top of the delta ladder) was reached, by pack label")
 SERVE_COALESCED_TICKS = REGISTRY.counter(
     "aiops_serve_coalesced_ticks_total",
     "Tick submissions whose deltas merged into a later, larger tick "
@@ -466,6 +467,29 @@ MESH_ATTEST_REPAIRS = REGISTRY.counter(
     "Attestation repair passes that re-uploaded mismatched shard blocks "
     "from the host-truth mirrors (no whole-state rebuild)")
 
+# graft-swell instrumentation (rca/elastic.py + multi-pack SurgeServer):
+# load-driven elastic meshes — scale events through the heal seams,
+# fleet bin-packing and live tenant migration.
+MESH_SCALE_EVENTS = REGISTRY.counter(
+    "aiops_mesh_scale_events_total",
+    "Load-driven D→D' reshards executed through the WAL-journaled "
+    "adopt_mesh seam, by direction label (up | down)")
+ELASTIC_SCALE_DECISIONS = REGISTRY.counter(
+    "aiops_elastic_scale_decisions_total",
+    "ElasticController hysteresis-gate firings that executed a scale "
+    "event (after dwell + cooldown), by direction label")
+FLEET_PACKS = REGISTRY.gauge(
+    "aiops_fleet_packs",
+    "Serving packs (MultiTenantScorer meshes) the fleet currently runs")
+FLEET_TENANT_MIGRATIONS = REGISTRY.counter(
+    "aiops_fleet_tenant_migrations_total",
+    "Completed tenant migrations between serving packs (journal-cursor "
+    "handoff, exactly-once)")
+FLEET_TENANT_LOAD = REGISTRY.gauge(
+    "aiops_fleet_tenant_load_rows_per_sec",
+    "Per-tenant admitted-rows/s EWMA load estimate the bin-packer "
+    "places by, by tenant label")
+
 # graft-evolve instrumentation (learn/): the online learning loop.
 # Every stage of the verdicts→checkpoint pipeline is counted — harvested
 # episodes, buffer occupancy, fine-tune steps, the gate's eval accuracy,
@@ -535,18 +559,19 @@ SCOPE_VERDICTS_OBSERVED = REGISTRY.counter(
 ROOFLINE_MODELED_BYTES = REGISTRY.gauge(
     "aiops_roofline_modeled_tick_bytes",
     "graft-cost modeled HBM bytes of the LIVE serving tick (traced at "
-    "its current compiled shapes), by entrypoint label")
+    "its current compiled shapes), by entrypoint and pack labels")
 ROOFLINE_HALO_BYTES = REGISTRY.gauge(
     "aiops_roofline_modeled_halo_bytes",
     "graft-cost modeled collective (halo) bytes of the live serving "
-    "tick, by entrypoint label")
+    "tick, by entrypoint and pack labels")
 ROOFLINE_ACHIEVED_BPS = REGISTRY.gauge(
     "aiops_roofline_achieved_bytes_per_sec",
     "Modeled tick bytes / host-observed device seconds (EWMA): the "
-    "achieved-bandwidth proxy the drift gauge compares against")
+    "achieved-bandwidth proxy the drift gauge compares against, per "
+    "(entrypoint, pack) series")
 ROOFLINE_DRIFT = REGISTRY.gauge(
     "aiops_roofline_drift",
     "Achieved bytes/sec vs the session's best observed for the same "
-    "entrypoint (1.0 = at the high-water mark; a sustained fall is "
+    "(entrypoint, pack) (1.0 = at the high-water mark; a sustained fall is "
     "measured performance decaying away from the cost model without a "
     "bench run)")

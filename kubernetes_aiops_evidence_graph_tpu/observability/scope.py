@@ -120,7 +120,7 @@ class TickSpan:
     __slots__ = ("tick_id", "t0", "marks", "queue_wait_s", "coalesced",
                  "pending", "shard_rows", "tier", "flags", "depth",
                  "backend", "fetched", "batch_incidents", "tenants",
-                 "params_gen")
+                 "params_gen", "pack")
 
     def __init__(self, tick_id: int, backend: str, depth: int,
                  tier: str, queue_wait_s: float) -> None:
@@ -146,6 +146,10 @@ class TickSpan:
         # dispatch so the flight ring shows exactly which ticks straddled
         # a hot checkpoint swap
         self.params_gen = 0
+        # graft-swell: which serving pack (mesh) this tick belongs to —
+        # with N packs the per-scorer gauges alias into one series unless
+        # every record and gauge sample carries the pack identity
+        self.pack = "0"
 
     def mark(self, stage: str) -> None:
         self.marks.append((stage, time.monotonic()))
@@ -182,6 +186,7 @@ class TickSpan:
             "batch_incidents": self.batch_incidents,
             "tenants": self.tenants,
             "params_gen": self.params_gen,
+            "pack": self.pack,
             "t_epoch_s": round(_epoch_of(self.t0), 6),
         }
 
@@ -353,11 +358,16 @@ class _Roofline:
         self._lock = threading.Lock()
         self._costs: dict[tuple, dict] = {}
         self._tracing: set[tuple] = set()
-        self._best: dict[str, float] = {}
-        self._ewma: dict[str, float] = {}
+        # graft-swell: achieved/best are PER (entrypoint, pack) — N packs
+        # running the same entrypoint are distinct serving meshes whose
+        # bandwidth stories must not EWMA into one series
+        self._best: dict[tuple[str, str], float] = {}
+        self._ewma: dict[tuple[str, str], float] = {}
+        self._gauged: set[tuple[str, str]] = set()
         self._threads: list[threading.Thread] = []
 
-    def model(self, entrypoint: str, key: tuple, fn, args) -> None:
+    def model(self, entrypoint: str, key: tuple, fn, args,
+              pack: str = "0") -> None:
         """Queue a background abstract trace of ``fn`` at ``args``'
         shapes/dtypes (one per shape key, ever). Only the avals leave the
         serving thread — captured as ShapeDtypeStructs BEFORE the real
@@ -369,21 +379,38 @@ class _Roofline:
         rca/streaming.py)."""
         k = (entrypoint, key)
         with self._lock:
-            if k in self._costs or k in self._tracing:
+            rec = self._costs.get(k)
+            if rec is not None:
+                # cost cache hit (shape-keyed — pack-independent): the
+                # only remaining work is making sure THIS pack's modeled
+                # gauges exist, once, ever
+                if (entrypoint, pack) in self._gauged:
+                    return
+                self._gauged.add((entrypoint, pack))
+            elif k in self._tracing:
                 return
-            self._tracing.add(k)
-            self._threads = [t for t in self._threads if t.is_alive()]
+            else:
+                self._tracing.add(k)
+                self._threads = [t for t in self._threads if t.is_alive()]
+        if rec is not None:
+            m.ROOFLINE_MODELED_BYTES.set(
+                float(rec["hbm_bytes"]), entrypoint=entrypoint, pack=pack)
+            m.ROOFLINE_HALO_BYTES.set(
+                float(rec["collective_bytes"]), entrypoint=entrypoint,
+                pack=pack)
+            return
         import jax
         absargs = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
         t = threading.Thread(target=self._trace_quiet,
-                             args=(entrypoint, key, fn, absargs),
+                             args=(entrypoint, key, fn, absargs, pack),
                              name="kaeg-scope-roofline", daemon=False)
         with self._lock:
             self._threads.append(t)
         t.start()
 
-    def _trace_quiet(self, entrypoint: str, key: tuple, fn, absargs) -> None:
+    def _trace_quiet(self, entrypoint: str, key: tuple, fn, absargs,
+                     pack: str = "0") -> None:
         try:
             import jax
             from ..analysis.cost_model import cost_jaxpr
@@ -402,10 +429,12 @@ class _Roofline:
         with self._lock:
             self._costs[(entrypoint, key)] = rec
             self._tracing.discard((entrypoint, key))
+            self._gauged.add((entrypoint, pack))
         m.ROOFLINE_MODELED_BYTES.set(
-            float(rec["hbm_bytes"]), entrypoint=entrypoint)
+            float(rec["hbm_bytes"]), entrypoint=entrypoint, pack=pack)
         m.ROOFLINE_HALO_BYTES.set(
-            float(rec["collective_bytes"]), entrypoint=entrypoint)
+            float(rec["collective_bytes"]), entrypoint=entrypoint,
+            pack=pack)
 
     def join(self) -> None:
         """Wait for in-flight traces (tests and the bench's record path —
@@ -416,10 +445,11 @@ class _Roofline:
             if t.is_alive():
                 t.join()
 
-    def observe(self, entrypoint: str, key: tuple, seconds: float) -> None:
+    def observe(self, entrypoint: str, key: tuple, seconds: float,
+                pack: str = "0") -> None:
         """Host-observed device window of one tick → achieved-bandwidth
         proxy (modeled bytes / seconds, EWMA-smoothed) and drift vs the
-        session high-water mark."""
+        session high-water mark — per (entrypoint, pack) series."""
         if seconds <= 0:
             return
         with self._lock:
@@ -427,15 +457,30 @@ class _Roofline:
         if not rec or not rec["hbm_bytes"]:
             return
         bps = rec["hbm_bytes"] / seconds
+        series = (entrypoint, pack)
         with self._lock:
-            prev = self._ewma.get(entrypoint)
+            prev = self._ewma.get(series)
             ewma = bps if prev is None else 0.9 * prev + 0.1 * bps
-            self._ewma[entrypoint] = ewma
-            best = max(self._best.get(entrypoint, 0.0), ewma)
-            self._best[entrypoint] = best
-        m.ROOFLINE_ACHIEVED_BPS.set(ewma, entrypoint=entrypoint)
+            self._ewma[series] = ewma
+            best = max(self._best.get(series, 0.0), ewma)
+            self._best[series] = best
+        m.ROOFLINE_ACHIEVED_BPS.set(ewma, entrypoint=entrypoint, pack=pack)
         m.ROOFLINE_DRIFT.set(ewma / best if best else 0.0,
-                             entrypoint=entrypoint)
+                             entrypoint=entrypoint, pack=pack)
+
+    def achieved(self, entrypoint: str, pack: str = "0") -> float:
+        """EWMA achieved-bytes/s for one (entrypoint, pack) series (0.0
+        until the first observed tick) — the ElasticController's roofline
+        input, read without touching the gauge registry."""
+        with self._lock:
+            return self._ewma.get((entrypoint, pack), 0.0)
+
+    def best(self, entrypoint: str, pack: str = "0") -> float:
+        """Session high-water achieved-bytes/s for one series (0.0 until
+        the first observed tick) — the denominator of the drift signal
+        the ElasticController treats as its roofline ceiling proxy."""
+        with self._lock:
+            return self._best.get((entrypoint, pack), 0.0)
 
 
 ROOFLINE = _Roofline()
@@ -451,12 +496,16 @@ class TickScope:
     thread carries a live trace context, emits the tick and its stage
     children as spans of that trace."""
 
-    def __init__(self, backend: str, settings=None) -> None:
+    def __init__(self, backend: str, settings=None,
+                 pack: str = "0") -> None:
         if settings is None:
             from ..config import get_settings
             settings = get_settings()
         self.enabled = bool(getattr(settings, "scope_telemetry", True))
         self.backend = backend
+        # graft-swell: the owning serving pack's id — stamped onto every
+        # TickSpan so multi-mesh flight records stay attributable
+        self.pack = str(pack)
         self._serial = 0
         self._pending_queue_wait = 0.0
         self._stage_keys: dict[str, tuple] = {}
@@ -483,6 +532,7 @@ class TickScope:
         span = TickSpan(self._serial, self.backend,
                         int(getattr(scorer, "pipeline_depth", 1)),
                         str(getattr(scorer, "_scope_tier", "steady")), qw)
+        span.pack = self.pack
         if STORM_FLAG["active"]:
             span.flag("storm")
         return span
@@ -501,7 +551,7 @@ class TickScope:
             return
         FLIGHT_RECORDER.record({
             "event": "coalesced", "backend": self.backend,
-            "pending": int(pending),
+            "pack": self.pack, "pending": int(pending),
             "t_epoch_s": round(_epoch_of(time.monotonic()), 6)})
 
     # retirement -----------------------------------------------------------

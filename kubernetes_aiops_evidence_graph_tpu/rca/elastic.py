@@ -1,0 +1,251 @@
+"""graft-swell: load-driven elastic serving meshes.
+
+graft-heal (rca/heal.py + shield.mesh_heal) built the expensive
+machinery for moving the resident serving state between mesh layouts —
+WAL-journaled ``adopt_mesh`` at a queue generation boundary,
+``warm_mesh`` pre-compilation so the move pays an upload, never a
+compile, and a bit-parity contract proven by the heal tests. But only
+device FAILURE triggered it. This module generalizes the trigger to
+LOAD: an :class:`ElasticController` consumes the gauges graft-scope
+already exports for every serving pack —
+
+- pipeline occupancy (dispatched-but-unfetched ticks / pipeline depth)
+  and stall seconds (time blocked for a pipeline slot),
+- the admission layer's shed-ratio EWMA (demand the gate is refusing),
+- roofline drift (achieved-bytes/s EWMA vs the session high-water
+  mark: a tick running at its bandwidth ceiling cannot absorb more
+  load at the current shard count)
+
+— and drives hysteresis+dwell-gated D→D' decisions through the
+EXISTING heal seams (``shield.scale_mesh``). The two-threshold + dwell
+gate is ingestion/admission.StormMode's pattern verbatim: sustained
+pressure for ``elastic_dwell_s`` scales up, sustained calm scales
+down, and a flapping signal can never flap the mesh.
+
+Scale-event discipline (the whole point of reusing the heal seams):
+
+1. ``prewarm(d_new)`` compiles every serving-reachable tick variant at
+   the TARGET shard count on a background warm thread (the scorer's
+   ``warm_mesh`` seam — cooperative-cancel, compile-cache keyed), so
+2. ``shield.scale_mesh(d_new)`` — WAL-journal FIRST, then
+   ``adopt_mesh`` at a queue generation boundary — pays buffer uploads
+   only. Zero XLA compiles inside the armed scale window is a CI leg
+   (KAEG_COMPILE_FENCE=1), not a hope.
+3. Bit-parity holds across D→D'→D: rules verdicts bit-identical, GNN
+   verdicts verdict-identical, ppermute census exactly
+   ``(LAYERS+1)·D'`` — the same contract the heal tests pin.
+
+The controller never spawns its own thread: ``observe()`` is called
+from whatever cadence the host already has (the workflow worker's
+absorb loop, a bench harness, a test with a fake clock), mirroring how
+StormMode is fed by the admission gate.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..config import get_settings
+from ..observability import metrics as obs_metrics
+from ..observability import scope as obs_scope
+from ..observability.logging import get_logger
+
+log = get_logger("elastic")
+
+
+class _HysteresisGate:
+    """Two-threshold + dwell gate (the StormMode pattern, direction-
+    agnostic): ``update(hot)`` feeds one boolean pressure observation
+    and returns True exactly once per sustained-entry — the caller
+    resets by the act of scaling (which changes the signal)."""
+
+    def __init__(self, dwell_s: float, clock=time.monotonic) -> None:
+        self.dwell_s = float(dwell_s)
+        self._clock = clock
+        self._since: float | None = None
+
+    def update(self, hot: bool) -> bool:
+        now = self._clock()
+        if not hot:
+            self._since = None
+            return False
+        if self._since is None:
+            self._since = now
+        return now - self._since >= self.dwell_s
+
+    def reset(self) -> None:
+        self._since = None
+
+
+class ElasticController:
+    """Load-driven D→D' scale decisions for ONE shielded serving pack.
+
+    ``observe()`` samples the pack's pressure signals, feeds the up/down
+    hysteresis gates, and — when a gate fires and the cooldown has
+    passed — pre-warms the target mesh and executes the reshard through
+    ``shield.scale_mesh``. All decisions ride the divisor ladder: D'
+    must divide ``padded_nodes`` and fit the non-excluded device count,
+    so the reshard is always exact (no re-padding, bit-parity safe).
+    """
+
+    def __init__(self, shield, settings=None, admission=None,
+                 clock=time.monotonic) -> None:
+        self.settings = settings or get_settings()
+        self.shield = shield
+        self.admission = admission
+        self._clock = clock
+        s = self.settings
+        self.enabled = bool(getattr(s, "elastic_enabled", False))
+        self.cooldown_s = float(getattr(s, "elastic_cooldown_s", 30.0))
+        dwell = float(getattr(s, "elastic_dwell_s", 10.0))
+        self._up = _HysteresisGate(dwell, clock)
+        self._down = _HysteresisGate(dwell, clock)
+        self._lock = threading.Lock()
+        self._last_scale_t: float | None = None
+        self._last_stall = 0.0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.decisions = 0
+
+    # -- signals -----------------------------------------------------------
+
+    def signals(self) -> dict:
+        """One pressure sample from the pack's existing telemetry — all
+        plain attribute/EWMA reads, no device syncs, no gauge-registry
+        round-trips."""
+        sc = self.shield.scorer
+        depth = max(int(getattr(sc, "pipeline_depth", 1)), 1)
+        occupancy = len(getattr(sc, "_inflight", ())) / depth
+        stall_total = float(getattr(sc, "stall_seconds", 0.0))
+        stall_delta = max(stall_total - self._last_stall, 0.0)
+        self._last_stall = stall_total
+        shed = 0.0
+        if self.admission is not None:
+            shed = float(self.admission.stats().get("shed_ewma", 0.0))
+        entry = getattr(sc, "_scope_entry", "streaming.rules_tick")
+        pack = getattr(sc, "_scope_pack", "0")
+        achieved = obs_scope.ROOFLINE.achieved(entry, pack)
+        best = obs_scope.ROOFLINE.best(entry, pack)
+        drift = (achieved / best) if best else 0.0
+        return {"occupancy": occupancy, "stall_delta_s": stall_delta,
+                "shed_ewma": shed, "roofline_drift": drift,
+                "shards": int(sc._graph_size())}
+
+    def _hot(self, sig: dict) -> bool:
+        s = self.settings
+        return (sig["occupancy"] >= float(
+                    getattr(s, "elastic_up_occupancy", 0.75))
+                or sig["shed_ewma"] >= float(
+                    getattr(s, "elastic_up_shed", 0.05))
+                or sig["stall_delta_s"] > 0.0
+                or sig["roofline_drift"] >= float(
+                    getattr(s, "elastic_up_roofline", 0.85)))
+
+    def _cold(self, sig: dict) -> bool:
+        s = self.settings
+        return (sig["occupancy"] <= float(
+                    getattr(s, "elastic_down_occupancy", 0.25))
+                and sig["shed_ewma"] <= float(
+                    getattr(s, "elastic_down_shed", 0.005))
+                and sig["stall_delta_s"] == 0.0
+                and (sig["roofline_drift"] <= float(
+                    getattr(s, "elastic_down_roofline", 0.30))
+                    or sig["roofline_drift"] == 0.0))
+
+    # -- the divisor ladder ------------------------------------------------
+
+    def ladder(self) -> tuple[int, ...]:
+        """Viable shard counts: divisors of the pack's ``padded_nodes``
+        that fit within the non-excluded device count, ascending."""
+        import jax
+        sc = self.shield.scorer
+        pn = int(sc.snapshot.padded_nodes)
+        avail = len(jax.devices()) - len(
+            getattr(self.shield, "_mesh_excluded", ()))
+        return tuple(d for d in range(1, max(avail, 1) + 1)
+                     if pn % d == 0)
+
+    def _step(self, direction: int) -> int | None:
+        """Next rung of the ladder from the CURRENT shard count (+1 =
+        up, -1 = down); None at the ladder's end."""
+        rungs = self.ladder()
+        cur = int(self.shield.scorer._graph_size())
+        if direction > 0:
+            bigger = [d for d in rungs if d > cur]
+            return bigger[0] if bigger else None
+        smaller = [d for d in rungs if d < cur]
+        return smaller[-1] if smaller else None
+
+    # -- execution ---------------------------------------------------------
+
+    def prewarm(self, target_shards: int,
+                delta_sizes=(64,), row_sizes=(4,)) -> None:
+        """Compile the serving tick variants at the TARGET shard count
+        BEFORE the scale event, on the calling thread, through the same
+        ``warm_mesh`` seam graft-heal proved — the subsequent
+        ``scale_mesh`` then pays an upload, never a compile."""
+        from . import heal as heal_mod
+        excluded = getattr(self.shield, "_mesh_excluded", ())
+        mesh = heal_mod.survivor_mesh(int(target_shards), excluded)
+        scorer = self.shield.scorer
+        scorer.warm_mesh(mesh, delta_sizes=tuple(delta_sizes),
+                         row_sizes=tuple(row_sizes))
+
+    def _cooled(self, now: float) -> bool:
+        return (self._last_scale_t is None
+                or now - self._last_scale_t >= self.cooldown_s)
+
+    def observe(self) -> dict:
+        """Feed one pressure sample; possibly execute a scale event.
+        Returns the decision record (also appended to the fleet history
+        by the owning SurgeServer)."""
+        with self._lock:
+            self.decisions += 1
+            sig = self.signals()
+            fire_up = self._up.update(self._hot(sig))
+            fire_down = self._down.update(self._cold(sig))
+            now = self._clock()
+            decision = {"action": "hold", **sig}
+            if not self.enabled:
+                return decision
+            if fire_up and self._cooled(now):
+                target = self._step(+1)
+                if target is not None:
+                    decision = self._scale(target, "up", now, sig)
+            elif fire_down and not fire_up and self._cooled(now):
+                target = self._step(-1)
+                if target is not None:
+                    decision = self._scale(target, "down", now, sig)
+            return decision
+
+    def _scale(self, target: int, direction: str, now: float,
+               sig: dict) -> dict:
+        """Caller holds ``self._lock``. Pre-warm, then reshard through
+        the WAL-journaled seam; both gates reset so the next decision
+        needs a fresh sustained signal."""
+        self.prewarm(target)
+        plan = self.shield.scale_mesh(target)
+        self._up.reset()
+        self._down.reset()
+        self._last_scale_t = now
+        if plan is None:
+            return {"action": "hold", **sig}
+        if direction == "up":
+            self.scale_ups += 1
+        else:
+            self.scale_downs += 1
+        obs_metrics.ELASTIC_SCALE_DECISIONS.inc(direction=direction)
+        log.warning("elastic_scale", direction=direction,
+                    from_shards=plan["from_shards"],
+                    to_shards=plan["shards"],
+                    occupancy=round(sig["occupancy"], 3),
+                    shed_ewma=round(sig["shed_ewma"], 4))
+        return {"action": f"scale_{direction}", "plan": plan, **sig}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": self.enabled,
+                    "decisions": self.decisions,
+                    "scale_ups": self.scale_ups,
+                    "scale_downs": self.scale_downs,
+                    "last_scale_t": self._last_scale_t}

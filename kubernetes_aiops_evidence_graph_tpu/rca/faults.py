@@ -94,7 +94,15 @@ LEARN_STAGES = ("harvest", "swap")
 #                      lease (a worker that dies again right away)
 WORKFLOW_STAGES = ("collect", "journal_put", "wf_execute", "verify",
                    "compensate", "crash_restart")
-STAGES = TICK_STAGES + INGEST_STAGES + LEARN_STAGES + WORKFLOW_STAGES
+# graft-swell: the tenant-migration handoff boundaries (SurgeServer
+# ``migrate``). ONE stage name, three hook visits per migration — after
+# the fleet-journal intent append, after the source pack's incremental
+# repack, and after the destination adopt — so a seeded schedule can
+# crash a migration at any boundary and the recovery replay must still
+# land the tenant with exactly one owner.
+MIGRATE_STAGES = ("migrate",)
+STAGES = (TICK_STAGES + INGEST_STAGES + LEARN_STAGES + WORKFLOW_STAGES
+          + MIGRATE_STAGES)
 
 # value-corruption stages return poisoned data instead of raising
 _POISON_STAGES = frozenset({"delta_values"})

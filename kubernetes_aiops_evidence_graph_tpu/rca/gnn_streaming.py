@@ -1196,7 +1196,7 @@ class GnnStreamingScorer(StreamingScorer):
             tick, args = self._scope_tick_fn(self._scope_entry, args,
                                              pk, ek, pi)
         obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
-                                 tick, args)
+                                 tick, args, pack=self._scope_pack)
 
     def _fetch_verdicts(self, handles, span, stats: dict,
                         queue_wait_s: float, dispatch_s: float) -> dict:
@@ -1224,7 +1224,7 @@ class GnnStreamingScorer(StreamingScorer):
             exec_s = span.splits().get("execute", 0.0)
             self.scope.finalize(span, fetched=True)
             obs_scope.ROOFLINE.observe(self._scope_entry, self._scope_key,
-                                       exec_s)
+                                       exec_s, pack=self._scope_pack)
         self.fetches += 1
         obs_metrics.SERVE_FETCHED_BYTES.inc(
             float(probs.nbytes), path="gnn_rescore")

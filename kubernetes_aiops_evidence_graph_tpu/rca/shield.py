@@ -234,6 +234,9 @@ class ShieldedScorer:
         self.reexpansions = 0
         self.attest_repairs = 0
         self.last_heal_seconds = 0.0
+        # graft-swell: load-driven scale events through the same WAL seam
+        self.scale_events = 0
+        self.last_scale_seconds = 0.0
 
     # -- delegation --------------------------------------------------------
 
@@ -782,6 +785,63 @@ class ShieldedScorer:
                     probed=excluded)
         return {"from_shards": d_old, "shards": d_new,
                 "probed": excluded, "heal_gen": heal_gen}
+
+    def scale_mesh(self, target_shards: int) -> "dict | None":
+        """graft-swell: LOAD-driven D→D' reshard through the exact seam
+        graft-heal proved — WAL-journal first (the recovery replay treats
+        it as one more ``mesh_heal`` record, no new replay path), then
+        ``adopt_mesh`` at a queue generation boundary, keeping whatever
+        devices the breaker currently excludes out of the new layout.
+        The ElasticController pre-warms the target mesh before calling
+        this, so the event pays an upload, never a compile. Also moves
+        the elastic HOME: a later fault-heal + re-expansion returns to
+        the load-chosen D', not the boot-time shard count. Returns the
+        plan, or None when already at the target."""
+        from . import heal as heal_mod
+        s = self.scorer
+        d_target = int(target_shards)
+        t0 = time.perf_counter()
+        with s.serve_lock:
+            d_old = s._graph_size()
+            if d_target == d_old:
+                return None
+            excluded = self._mesh_excluded
+            if d_target < 1 or s.snapshot.padded_nodes % d_target:
+                raise ValueError(
+                    f"scale target {d_target} does not divide "
+                    f"padded_nodes={s.snapshot.padded_nodes}")
+            survivors = len(jax.devices()) - len(excluded)
+            if d_target > survivors:
+                raise RuntimeError(
+                    f"scale target {d_target} exceeds {survivors} "
+                    "non-excluded devices")
+            seq = int(s._synced_seq)
+            self._heal_gen += 1
+            heal_gen = self._heal_gen   # captured under serve_lock for
+            # the post-lock telemetry, same as mesh_heal
+            self.journal.append(
+                (), seq, seq, kind="mesh_heal", force_sync=True,
+                shards=d_target, exclude=excluded, from_shards=d_old,
+                heal_gen=heal_gen, scale=True)
+            mesh = heal_mod.survivor_mesh(d_target, excluded)
+            s.adopt_mesh(mesh)
+            self._mesh_home = d_target
+        direction = "up" if d_target > d_old else "down"
+        self.scale_events += 1
+        self.last_scale_seconds = time.perf_counter() - t0
+        obs_metrics.MESH_SCALE_EVENTS.inc(direction=direction)
+        obs_metrics.MESH_SERVING_SHARDS.set(float(max(d_target, 1)))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "mesh_scale", from_shards=d_old, to_shards=d_target,
+            direction=direction, heal_gen=heal_gen)
+        # same snapshot-forcing rule as the heal: the on-disk snapshot
+        # still carries the OLD mesh shape
+        self._ticks_since_snapshot = self.snapshot_every
+        log.warning("mesh_scaled", from_shards=d_old,
+                    to_shards=d_target, direction=direction,
+                    seconds=round(self.last_scale_seconds, 4))
+        return {"from_shards": d_old, "shards": d_target,
+                "direction": direction, "heal_gen": heal_gen}
 
     def _attest_and_repair(self) -> tuple[int, ...]:
         """Per-shard state attestation at a snapshot generation boundary

@@ -439,6 +439,10 @@ class StreamingScorer:
         self._scope_coalesced_since = 0
         self._scope_key: tuple = ()
         self._scope_entry = "streaming.rules_tick"
+        # graft-swell: the owning serving pack's id (SurgeServer stamps
+        # the pack index when it builds a fleet) — labels the per-scorer
+        # pipeline/roofline gauges so N packs don't alias into one series
+        self._scope_pack = "0"
         # coalesced-serving state (see serve()): one device pass satisfies
         # every caller whose store writes preceded that pass's sync
         self._serve_cv = threading.Condition()
@@ -1685,7 +1689,7 @@ class StreamingScorer:
                                pk, rk, sharded)
             self._scope_entry = self._scope_entrypoint(sharded)
             obs_scope.ROOFLINE.model(self._scope_entry, self._scope_key,
-                                     tick, args)
+                                     tick, args, pack=self._scope_pack)
         out = tick(*args)
         (self._features_dev, self._ev_idx_dev, self._ev_cnt_dev,
          self._pair_dev) = out[:4]
@@ -2000,7 +2004,8 @@ class StreamingScorer:
         if n0 != len(self._inflight):
             obs_metrics.SERVE_DEFERRED_FETCHES.inc(
                 float(n0 - len(self._inflight)))
-        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(float(len(self._inflight)))
+        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
+            float(len(self._inflight)), pack=self._scope_pack)
 
     def _retire_meta(self, mark_execute: bool = False) -> None:
         if not self._inflight_meta:
@@ -2118,14 +2123,15 @@ class StreamingScorer:
             # host-observed, so stamp its execute boundary
             self.scope.note_queue_wait(stall)
             self._retire_meta(mark_execute=True)
-            obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(stall)
+            obs_metrics.SERVE_PIPELINE_STALL_SECONDS.inc(
+                stall, pack=self._scope_pack)
             obs_metrics.SERVE_DEFERRED_FETCHES.inc()
         out = self.dispatch()
         self._inflight.append(self._tick_handles(out))
         self._inflight_meta.append(self._last_tick_span)
         self._last_tick_span = None
         obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
-            float(len(self._inflight)))
+            float(len(self._inflight)), pack=self._scope_pack)
         return {"dispatched": True, "coalesced": False,
                 "inflight": len(self._inflight), "pending": 0}
 
@@ -2140,7 +2146,8 @@ class StreamingScorer:
             self._inflight.clear()
         while self._inflight_meta:
             self._retire_meta()
-        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(0.0)
+        obs_metrics.SERVE_PIPELINE_INFLIGHT.set(
+            0.0, pack=self._scope_pack)
 
     def serve(self, newest: bool = False) -> dict:
         """Coalesced sync + rescore for concurrent serving callers.
@@ -2293,7 +2300,7 @@ class StreamingScorer:
             exec_s = span.splits().get("execute", 0.0)
             self.scope.finalize(span, fetched=True)
             obs_scope.ROOFLINE.observe(self._scope_entry, self._scope_key,
-                                       exec_s)
+                                       exec_s, pack=self._scope_pack)
         conds, matched, scores, top_idx, any_match, top_conf, top_score = (
             fetched)
         self.fetches += 1

@@ -52,7 +52,11 @@ ONE device pass, not one pass per incident.
 from __future__ import annotations
 
 import bisect
+import collections
+import json
+import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Iterable, Mapping
 
@@ -735,6 +739,66 @@ class MultiTenantScorer(StreamingScorer):
             out[t] = d
         return out
 
+    # -- graft-swell: live tenant membership (migration seams) --------------
+
+    def add_tenant(self, name: str, store: EvidenceGraphStore) -> None:
+        """Adopt one NEW tenant into the running pack at a generation
+        boundary: a fresh zero-sized region is appended and the
+        incremental ``_repack`` tensorizes ONLY the newcomer (a pn=0
+        region can never satisfy the keep condition), while every kept
+        region's host mirror moves by a row shift. This is the
+        destination half of a tenant migration — the journal-cursor
+        handoff above it (SurgeServer.migrate) owns exactly-once."""
+        with self.serve_lock:
+            if name in self._tenant_stores:
+                raise ValueError(f"tenant {name!r} already in the pack")
+            self._tenant_stores[name] = store
+            reg = TenantRegion(name=name, store=store)
+            self.regions[name] = reg
+            self._regions_order.append(reg)
+            self._repack()
+        self._rearm_warm_growth()
+        obs_scope.FLIGHT_RECORDER.note_event("tenant_adopted", tenant=name)
+        log.info("tenant_adopted", tenant=name,
+                 tenants=len(self._tenant_stores))
+
+    def remove_tenant(self, name: str) -> EvidenceGraphStore:
+        """Release one tenant from the running pack (the source half of
+        a migration): its region drops out of the packed slot spaces and
+        the incremental ``_repack`` row-shifts the survivors — no
+        surviving tenant pays a tensorize. The pack must keep at least
+        one tenant (an empty MultiTenantScorer cannot exist; the owning
+        SurgeServer drops the whole pack instead). Returns the released
+        tenant's store for the destination pack to adopt."""
+        with self.serve_lock:
+            if name not in self._tenant_stores:
+                raise KeyError(f"tenant {name!r} not in the pack")
+            if len(self._tenant_stores) == 1:
+                raise ValueError(
+                    "a pack cannot drop its last tenant — the owner "
+                    "retires the whole pack instead")
+            store = self._tenant_stores.pop(name)
+            reg = self.regions.pop(name)
+            self._regions_order.remove(reg)
+            # the departing region's staged deltas must not survive into
+            # the repacked slot spaces (quarantine's delta-scrub rule)
+            nb, ne = reg.node_base, reg.node_base + reg.pn
+            pf = self._pending_feat
+            if hasattr(pf, "discard_range"):
+                pf.discard_range(nb, ne)
+            else:
+                self._pending_feat = {k: v for k, v in pf.items()
+                                      if not nb <= k < ne}
+            ib, ie = reg.inc_base, reg.inc_base + reg.pi
+            self._dirty_rows = {r for r in self._dirty_rows
+                                if not ib <= r < ie}
+            self._repack()
+        self._rearm_warm_growth()
+        obs_scope.FLIGHT_RECORDER.note_event("tenant_released", tenant=name)
+        log.info("tenant_released", tenant=name,
+                 tenants=len(self._tenant_stores))
+        return store
+
 
 def swap_tenants_atomically(targets, params, source: str = "") -> int:
     """graft-evolve: flip EVERY tenant's resident GNN scorer to one new
@@ -783,24 +847,95 @@ def swap_tenants_atomically(targets, params, source: str = "") -> int:
     return gen
 
 
+class _FleetJournal:
+    """Append-only WAL for fleet PLACEMENT mutations (graft-swell).
+
+    The shield's record journal cannot own tenant migration — the shield
+    is unsupported on packs (``ShieldedScorer`` needs ``scorer.store``;
+    a MultiTenantScorer has none) — so the fleet keeps its own tiny WAL
+    with the same discipline: journal-before-mutate, fsync on append,
+    roll-FORWARD replay. Records are plain dicts; with ``path=None`` the
+    journal is in-memory only (single-process tests, the default
+    single-pack deployment where placement is trivially recoverable)."""
+
+    def __init__(self, path: "str | None" = None) -> None:
+        self.path = path or None
+        self._lock = threading.Lock()
+        self._records: list[dict] = []
+        if self.path and os.path.exists(self.path):
+            with open(self.path) as f:
+                self._records = [json.loads(line)
+                                 for line in f if line.strip()]
+
+    def append(self, rec: dict) -> None:
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            self._records.append(dict(rec))
+            if self.path:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+
+    def replay(self) -> list[dict]:
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+
 class SurgeServer:
-    """Process-wide multi-tenant serving front-end.
+    """Process-wide multi-tenant serving front-end — a FLEET of packs.
 
     Per-tenant workflow workers register their builder's store at
-    construction; the shared :class:`MultiTenantScorer` builds lazily on
-    the first ``scorer()`` call (heavy — tensorize + upload; workers call
-    it off the event loop). Registering a NEW tenant after the build
-    marks the pack stale: the next ``scorer()`` repacks, and workers
-    detect staleness cheaply via ``fresh()`` on their serve fast path.
+    construction; each tenant is bin-packed onto one
+    :class:`MultiTenantScorer` pack (its own serving mesh), placed by
+    per-tenant load estimate (admitted-rows/s EWMA over store-journal
+    cursor deltas). Packs build lazily on the first ``scorer(tenant)``
+    call; registering a NEW tenant after a build marks only its pack
+    stale, and workers detect staleness cheaply via ``fresh()``.
+
+    With ``settings.swell_max_packs == 1`` (the default) every tenant
+    lands on pack 0 and the behavior is exactly the single-pack PR-9
+    server. With N packs, ``migrate()`` moves a tenant between packs
+    live: journal-cursor handoff through the fleet WAL
+    (journal-before-mutate, exactly-once — crash mid-migration recovers
+    to exactly one owner), incremental repack on the source
+    (``remove_tenant``) and destination (``add_tenant``), both at queue
+    generation boundaries.
     """
 
-    def __init__(self, settings: Settings | None = None) -> None:
+    HISTORY_CAP = 64
+
+    def __init__(self, settings: Settings | None = None,
+                 journal_path: "str | None" = None) -> None:
         self.settings = settings or get_settings()
+        s = self.settings
+        self.max_packs = max(int(getattr(s, "swell_max_packs", 1)), 1)
+        self.pack_tenants = max(
+            int(getattr(s, "swell_pack_tenants", 4)), 1)
+        self._load_alpha = float(getattr(s, "swell_load_alpha", 0.2))
         self._stores: dict[str, EvidenceGraphStore] = {}
-        self._scorer: MultiTenantScorer | None = None
-        self._built_over: frozenset = frozenset()
         self._lock = threading.Lock()
         self.generation = 0
+        self.migrations = 0
+        # tenant -> pack id (the single source of ownership truth:
+        # every tenant appears exactly once, by construction)
+        self._placement: dict[str, int] = {}
+        self._packs: dict[int, MultiTenantScorer] = {}
+        self._pack_built: dict[int, frozenset] = {}
+        # per-tenant admitted-rows/s EWMA + the journal cursor sample it
+        # was last advanced from
+        self._loads: dict[str, float] = {}
+        self._load_cursor: dict[str, tuple[int, float]] = {}
+        self._history: collections.deque = collections.deque(
+            maxlen=self.HISTORY_CAP)
+        # graft-chaos seam: tests install a FaultInjector; migrate()
+        # visits the "migrate" stage at each handoff boundary
+        self.fault_injector = None
+        self._fleet_journal = _FleetJournal(
+            journal_path or getattr(s, "swell_journal_path", "") or None)
+        self._recover_placement()
+
+    # -- registration / placement ------------------------------------------
 
     def register(self, tenant: str, store: EvidenceGraphStore) -> None:
         with self._lock:
@@ -810,26 +945,241 @@ class SurgeServer:
                     f"tenant {tenant!r} already registered with a "
                     "different store")
             self._stores[tenant] = store
+            if tenant not in self._placement:
+                self._placement[tenant] = self._place_locked(tenant)
+
+    def _place_locked(self, tenant: str) -> int:
+        """Greedy bin-pack for a new tenant: the least-loaded pack with
+        tenant capacity; a fresh pack when every open pack is full and
+        the fleet has room; otherwise the least-loaded pack regardless
+        (capacity is a target, not a hard wall — admission control owns
+        hard limits)."""
+        counts: dict[int, int] = {p: 0 for p in range(
+            len(set(self._placement.values())))}
+        loads: dict[int, float] = {}
+        for t, p in self._placement.items():
+            counts[p] = counts.get(p, 0) + 1
+            loads[p] = loads.get(p, 0.0) + self._loads.get(t, 0.0)
+        open_packs = sorted(counts)
+        with_room = [p for p in open_packs
+                     if counts[p] < self.pack_tenants]
+        if with_room:
+            return min(with_room,
+                       key=lambda p: (loads.get(p, 0.0), counts[p], p))
+        if len(open_packs) < self.max_packs:
+            return (max(open_packs) + 1) if open_packs else 0
+        if not open_packs:
+            return 0
+        return min(open_packs,
+                   key=lambda p: (loads.get(p, 0.0), counts[p], p))
+
+    def _recover_placement(self) -> None:
+        """Roll the fleet WAL FORWARD: an intent record already moves
+        ownership to the destination (the cursor handoff is in the
+        record; the packs rebuild from stores, so a crash between the
+        intent and any mutate boundary loses no data). After replay
+        every migrated tenant has exactly one owner — the later of its
+        records wins, and registration honors the recovered placement."""
+        for rec in self._fleet_journal.replay():
+            if rec.get("kind") in ("migrate_intent", "migrate_commit"):
+                self._placement[str(rec["tenant"])] = int(rec["dst"])
+
+    # -- pack building ------------------------------------------------------
+
+    def _tenants_of_locked(self, pack_id: int) -> frozenset:
+        return frozenset(t for t, p in self._placement.items()
+                         if p == pack_id and t in self._stores)
 
     def fresh(self) -> bool:
-        """True when the built pack covers every registered tenant —
-        the worker fast path's cheap staleness probe."""
+        """True when every pack with placed tenants is built over
+        exactly its current tenant set — the worker fast path's cheap
+        staleness probe."""
         with self._lock:
-            return (self._scorer is not None
-                    and frozenset(self._stores) == self._built_over)
+            for pack_id in set(self._placement.values()):
+                names = self._tenants_of_locked(pack_id)
+                if not names:
+                    continue
+                if (self._packs.get(pack_id) is None
+                        or self._pack_built.get(pack_id) != names):
+                    return False
+            return bool(self._stores)
 
-    def scorer(self) -> MultiTenantScorer:
-        """The shared pack, (re)built if tenants registered since the
-        last build. A repack supersedes the old scorer (its warm threads
-        are stopped; in-flight results were per-pack anyway)."""
+    def scorer(self, tenant: "str | None" = None) -> MultiTenantScorer:
+        """The pack serving ``tenant``, (re)built if its tenant set
+        changed since the last build. ``tenant=None`` (back-compat:
+        single-pack callers, benches) returns the lowest-numbered pack.
+        A repack supersedes the old scorer (warm threads stopped;
+        in-flight results were per-pack anyway)."""
         with self._lock:
-            names = frozenset(self._stores)
-            if self._scorer is None or names != self._built_over:
-                if self._scorer is not None:
-                    self._scorer.stop_warm(join=False)
-                    log.info("surge_repack", tenants=sorted(names))
-                self._scorer = MultiTenantScorer(dict(self._stores),
-                                                 self.settings)
-                self._built_over = names
-                self.generation += 1
-            return self._scorer
+            if tenant is None:
+                pack_id = min(set(self._placement.values()), default=0)
+            else:
+                if tenant not in self._placement:
+                    raise KeyError(f"tenant {tenant!r} not registered")
+                pack_id = self._placement[tenant]
+            return self._build_pack_locked(pack_id)
+
+    def _build_pack_locked(self, pack_id: int) -> MultiTenantScorer:
+        names = self._tenants_of_locked(pack_id)
+        if not names:
+            raise ValueError(f"no tenants placed on pack {pack_id}")
+        cur = self._packs.get(pack_id)
+        if cur is None or names != self._pack_built.get(pack_id):
+            if cur is not None:
+                cur.stop_warm(join=False)
+                log.info("surge_repack", pack=pack_id,
+                         tenants=sorted(names))
+            pack = MultiTenantScorer(
+                {t: self._stores[t] for t in sorted(names)},
+                self.settings)
+            # graft-swell satellite: stamp the pack identity into the
+            # scorer's telemetry so N packs never alias one gauge series
+            pack._scope_pack = str(pack_id)
+            pack.scope.pack = str(pack_id)
+            self._packs[pack_id] = pack
+            self._pack_built[pack_id] = names
+            self.generation += 1
+            obs_metrics.FLEET_PACKS.set(float(len(self._packs)))
+        return self._packs[pack_id]
+
+    # -- per-tenant load estimation ----------------------------------------
+
+    def sample_loads(self, now_s: "float | None" = None) -> dict:
+        """Advance every tenant's admitted-rows/s EWMA from its store
+        journal cursor (admitted rows land in the journal; the cursor
+        delta over wall time is the admission rate the bin-packer and
+        the fleet API report). Injectable clock for tests."""
+        now = time.monotonic() if now_s is None else float(now_s)
+        with self._lock:
+            for tenant, store in self._stores.items():
+                seq = int(store.journal_seq)
+                prev = self._load_cursor.get(tenant)
+                self._load_cursor[tenant] = (seq, now)
+                if prev is None:
+                    continue
+                seq0, t0 = prev
+                dt = now - t0
+                if dt <= 0:
+                    continue
+                rate = max(seq - seq0, 0) / dt
+                old = self._loads.get(tenant)
+                a = self._load_alpha
+                ewma = rate if old is None else (1 - a) * old + a * rate
+                self._loads[tenant] = ewma
+                obs_metrics.FLEET_TENANT_LOAD.set(ewma, tenant=tenant)
+            return dict(self._loads)
+
+    # -- live tenant migration ---------------------------------------------
+
+    def _fault(self, stage: str) -> None:
+        fi = self.fault_injector
+        if fi is not None:
+            fi.at(stage)
+
+    def migrate(self, tenant: str, dst: int) -> dict:
+        """Move one tenant between packs LIVE, exactly-once.
+
+        Order (the shield's journal-before-mutate discipline, on the
+        fleet WAL): (1) append the intent record — tenant, src, dst,
+        and the store-journal CURSOR at handoff — and fsync; (2)
+        incremental repack on the source (``remove_tenant``; the whole
+        pack retires instead when the tenant was its last); (3) flip
+        placement and adopt on the destination (``add_tenant`` when the
+        pack is live, else the next ``scorer()`` builds it); (4) append
+        the commit record. A crash at ANY boundary recovers to exactly
+        one owner: replay rolls the intent forward, packs rebuild from
+        stores, and the destination's first sync drains the tenant's
+        journal from the recorded cursor — records are applied once.
+        """
+        with self._lock:
+            if tenant not in self._stores:
+                raise KeyError(f"tenant {tenant!r} not registered")
+            dst = int(dst)
+            if dst < 0 or dst >= self.max_packs:
+                raise ValueError(
+                    f"destination pack {dst} outside the fleet "
+                    f"(max_packs={self.max_packs})")
+            src = self._placement[tenant]
+            if src == dst:
+                return {"tenant": tenant, "src": src, "dst": dst,
+                        "moved": False}
+            store = self._stores[tenant]
+            cursor = int(store.journal_seq)
+            self._fleet_journal.append(
+                {"kind": "migrate_intent", "tenant": tenant, "src": src,
+                 "dst": dst, "cursor": cursor, "gen": self.generation})
+            self._fault("migrate")          # crash at the journal boundary
+            src_pack = self._packs.get(src)
+            if src_pack is not None:
+                if len(self._pack_built.get(src, ())) <= 1:
+                    # last tenant: retire the whole pack, no repack
+                    src_pack.stop_warm(join=False)
+                    self._packs.pop(src, None)
+                    self._pack_built.pop(src, None)
+                else:
+                    src_pack.remove_tenant(tenant)
+                    self._pack_built[src] = \
+                        self._pack_built[src] - {tenant}
+            self._fault("migrate")          # crash at the repack boundary
+            self._placement[tenant] = dst
+            dst_pack = self._packs.get(dst)
+            if dst_pack is not None:
+                dst_pack.add_tenant(tenant, store)
+                self._pack_built[dst] = \
+                    self._pack_built.get(dst, frozenset()) | {tenant}
+            self._fault("migrate")          # crash at the adopt boundary
+            self._fleet_journal.append(
+                {"kind": "migrate_commit", "tenant": tenant, "src": src,
+                 "dst": dst, "cursor": cursor, "gen": self.generation})
+            self.generation += 1
+            self.migrations += 1
+            self._history.append(
+                {"event": "migrate", "tenant": tenant, "src": src,
+                 "dst": dst, "cursor": cursor, "gen": self.generation})
+            obs_metrics.FLEET_TENANT_MIGRATIONS.inc()
+            obs_metrics.FLEET_PACKS.set(float(len(self._packs)))
+        obs_scope.FLIGHT_RECORDER.note_event(
+            "tenant_migrate", tenant=tenant, src=src, dst=dst,
+            cursor=cursor)
+        log.warning("tenant_migrated", tenant=tenant, src=src, dst=dst,
+                    cursor=cursor)
+        return {"tenant": tenant, "src": src, "dst": dst, "moved": True,
+                "cursor": cursor}
+
+    def note_scale(self, pack_id: int, decision: dict) -> None:
+        """Record one ElasticController scale decision into the fleet
+        history ring (the /api/v1/fleet forensic surface)."""
+        if decision.get("action", "hold") == "hold":
+            return
+        with self._lock:
+            self._history.append(
+                {"event": decision["action"], "pack": int(pack_id),
+                 "plan": decision.get("plan"), "gen": self.generation})
+
+    # -- the fleet API surface ---------------------------------------------
+
+    def fleet(self) -> dict:
+        """Placement, per-tenant load estimates, and the scale/migration
+        history ring — the GET /api/v1/fleet payload."""
+        with self._lock:
+            packs: dict[str, dict] = {}
+            for pack_id in sorted(set(self._placement.values())):
+                names = sorted(self._tenants_of_locked(pack_id))
+                built = self._packs.get(pack_id) is not None
+                packs[str(pack_id)] = {
+                    "tenants": names,
+                    "built": built,
+                    "shards": (int(self._packs[pack_id]._graph_size())
+                               if built else 0),
+                }
+            return {
+                "packs": packs,
+                "placement": dict(self._placement),
+                "loads": {t: round(v, 3)
+                          for t, v in self._loads.items()},
+                "history": list(self._history),
+                "generation": self.generation,
+                "migrations": self.migrations,
+                "max_packs": self.max_packs,
+                "pack_tenants": self.pack_tenants,
+            }

@@ -115,7 +115,7 @@ class IncidentWorker:
             # the pack rebuilds store-derived — logged, never silent).
             if self.settings.shield_enabled:
                 log.warning("surge_shield_unsupported", tenant=self.tenant)
-            scorer = self.surge.scorer()
+            scorer = self.surge.scorer(self.tenant)
             with self._scorer_lock:
                 if not getattr(scorer, "_surge_warm_started", False):
                     scorer._surge_warm_started = True

@@ -250,10 +250,16 @@ def test_registry_pins_the_collective_contracts():
     # every single-device entrypoint bans all collectives: either the
     # implicit default (cost=None) or — for the pallas tier, where the
     # acceptance contract pins it explicitly — COST_DEFAULT itself
+    # (the graft-swell .elastic entry is a mesh entry at D'≠boot-D and
+    # carries its own one-psum contract, same as .sharded)
     for e in ENTRYPOINTS:
         if not e.name.startswith("sharded_gnn.") and \
-                not e.name.endswith(".sharded"):
+                not e.name.endswith((".sharded", ".elastic")):
             assert e.cost is None or e.cost is COST_DEFAULT, e.name
+    elastic = BY_NAME["streaming.rules_tick.elastic"].cost
+    assert elastic.expect_counts["psum"] == 1
+    assert elastic.expect_counts["ppermute"] == 0
+    assert elastic.expect_counts["all_gather"] == 0
     for name in ("ops.pallas_gather_matmul_segment",
                  "ops.pallas_gather_matmul_segment.bf16",
                  "gnn.forward.bucketed.pallas"):

@@ -296,7 +296,7 @@ def test_renaming_a_warm_path_is_caught(tmp_path):
     """The warm proof must verify against SOURCE, not trust the
     declaration table: renaming warm_gnn in a copy trips warm-gap."""
     for rel in ("rca/streaming.py", "rca/gnn_streaming.py",
-                "rca/surge.py"):
+                "rca/surge.py", "rca/elastic.py"):
         _copy_into(tmp_path, rel)
     assert _check_real_tree(tmp_path) == []   # faithful copies: clean
     dst = tmp_path / "rca/gnn_streaming.py"
@@ -311,7 +311,7 @@ def test_severing_the_dispatch_seam_is_caught(tmp_path):
     """A warm path that stops going through the serve seam warms a
     lookalike — the seam-reachability check must notice."""
     for rel in ("rca/streaming.py", "rca/gnn_streaming.py",
-                "rca/surge.py"):
+                "rca/surge.py", "rca/elastic.py"):
         _copy_into(tmp_path, rel)
     dst = tmp_path / "rca/gnn_streaming.py"
     dst.write_text(dst.read_text().replace("self._call_gnn_tick(",

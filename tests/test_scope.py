@@ -206,14 +206,15 @@ def test_roofline_gauges_track_live_tick(monkeypatch):
     ROOFLINE.join()   # background abstract traces
     scorer.rescore()  # second rescore observes against the cached model
     modeled = m.ROOFLINE_MODELED_BYTES.value(
-        entrypoint="streaming.rules_tick")
+        entrypoint="streaming.rules_tick", pack="0")
     assert modeled > 0.0, "live tick cost never landed in the gauge"
     # single-device tick: zero halo bytes by the fleet contract
     assert m.ROOFLINE_HALO_BYTES.value(
-        entrypoint="streaming.rules_tick") == 0.0
-    drift = m.ROOFLINE_DRIFT.value(entrypoint="streaming.rules_tick")
+        entrypoint="streaming.rules_tick", pack="0") == 0.0
+    drift = m.ROOFLINE_DRIFT.value(entrypoint="streaming.rules_tick",
+                                   pack="0")
     achieved = m.ROOFLINE_ACHIEVED_BPS.value(
-        entrypoint="streaming.rules_tick")
+        entrypoint="streaming.rules_tick", pack="0")
     assert achieved > 0.0
     assert 0.0 < drift <= 1.0, \
         "drift is achieved/best — can never exceed the high-water mark"

@@ -1,0 +1,769 @@
+"""graft-swell: load-driven elastic meshes + multi-pack tenant fleets.
+
+Contracts pinned here (ISSUE 19):
+
+* the hysteresis+dwell gate (StormMode's pattern) fires exactly once
+  per sustained pressure episode and a flapping signal never flaps;
+* the elastic ladder is the divisor ladder (D' | padded_nodes, D' <=
+  non-excluded devices) and the controller steps one rung at a time,
+  executing through the EXISTING heal seams — prewarm (warm_mesh) then
+  ``shield.scale_mesh`` (WAL-journal first, adopt at a generation
+  boundary);
+* a D=4 -> D'=3 -> D=4 scale round-trip under churn is BIT-identical
+  to never-scaled D=4 serving, the scale record replays through the
+  journal (one WAL winner after a crash), and the scaled GNN tick's
+  ppermute census is exactly (LAYERS+1)·D';
+* tenants bin-pack across packs by load, ``migrate()`` moves a tenant
+  live with verdict bit-parity and exactly-once ownership — crash at
+  ANY of the three handoff boundaries (journal-append, source repack,
+  destination adopt) recovers to exactly one owner;
+* GET /api/v1/fleet renders placement, loads, and the history ring
+  with two migrations in order;
+* zero XLA compiles inside an armed scale window (CompileFence leg);
+* the randomized chaos sweep interleaves scale events with shard_loss
+  and parity still holds (seed echoed; replay KAEG_CHAOS_SEED=<seed>).
+"""
+import json
+import os
+import tempfile
+import threading
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubernetes_aiops_evidence_graph_tpu.collectors import (
+    collect_all, default_collectors)
+from kubernetes_aiops_evidence_graph_tpu.config import load_settings
+from kubernetes_aiops_evidence_graph_tpu.graph import GraphBuilder
+from kubernetes_aiops_evidence_graph_tpu.graph.topology_sync import (
+    sync_topology)
+from kubernetes_aiops_evidence_graph_tpu.observability import (
+    metrics as obs_metrics)
+from kubernetes_aiops_evidence_graph_tpu.rca.elastic import (
+    ElasticController, _HysteresisGate)
+from kubernetes_aiops_evidence_graph_tpu.rca.faults import (
+    Fault, FaultInjector, InjectedFault)
+from kubernetes_aiops_evidence_graph_tpu.rca.heal import survivor_mesh
+from kubernetes_aiops_evidence_graph_tpu.rca.shield import ShieldedScorer
+from kubernetes_aiops_evidence_graph_tpu.rca.streaming import StreamingScorer
+from kubernetes_aiops_evidence_graph_tpu.rca.surge import (
+    MultiTenantScorer, SurgeServer)
+from kubernetes_aiops_evidence_graph_tpu.simulator import (
+    SCENARIOS, generate_cluster, inject)
+from kubernetes_aiops_evidence_graph_tpu.simulator.stream import (
+    churn_events, store_step)
+
+# every rung divides by 12 = lcm(4, 3): the D=4 layout and every rung
+# of the 4 -> 3 -> 4 scale round-trip satisfy pn % D == 0
+_BUCKETS = dict(node_bucket_sizes=(384, 1536),
+                edge_bucket_sizes=(2048, 8192),
+                incident_bucket_sizes=(12, 48))
+
+EVENTS, BATCH = 120, 20
+
+_VERDICT_KEYS = ("top_rule_index", "any_match", "top_confidence",
+                 "top_score", "scores", "conditions", "matched")
+
+FLEET_CFG = dict(
+    node_bucket_sizes=(256, 1024, 4096), edge_bucket_sizes=(1024, 4096),
+    incident_bucket_sizes=(8, 32), rca_backend="tpu")
+
+
+def _settings(**over):
+    over.setdefault("mesh_heal_cooldown_s", 3600.0)  # no implicit reexpand
+    over.setdefault("serve_pipeline_depth", 2)
+    over.setdefault("shield_snapshot_every_ticks", 3)
+    over.setdefault("shield_retry_backoff_s", 0.001)
+    over.setdefault("mesh_shard_failure_threshold", 3)
+    return load_settings(**_BUCKETS, **over)
+
+
+def _world(settings, seed=13, num_pods=120):
+    cluster = generate_cluster(num_pods=num_pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    injected = []
+    for i, name in enumerate(("crashloop_deploy", "oom", "network")):
+        inc = inject(cluster, name, keys[i * 5 % len(keys)], rng)
+        injected.append(inc)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, settings), parallel=False))
+    return cluster, builder, injected
+
+
+def _verdicts(out, injected):
+    alias = {f"incident:{inc.id}": f"inj-{i}"
+             for i, inc in enumerate(injected)}
+    keys = [k for k in _VERDICT_KEYS if k in out]
+    if "probs" in out:
+        keys = ["probs", "top_rule_index", "any_match", "top_confidence"]
+    return {alias.get(iid, iid): tuple(
+                np.asarray(out[k])[row].tobytes() for k in keys)
+            for row, iid in enumerate(out["incident_ids"])}
+
+
+def _tenant_world(seed, incidents=2, pods=36, cfg=None):
+    """One tenant's cluster + store (the graft-surge test idiom)."""
+    cfg = cfg or load_settings(**FLEET_CFG)
+    cluster = generate_cluster(num_pods=pods, seed=seed)
+    rng = np.random.default_rng(seed)
+    builder = GraphBuilder()
+    sync_topology(cluster, builder.store)
+    keys = sorted(cluster.deployments)
+    names = sorted(SCENARIOS)
+    for i in range(incidents):
+        inc = inject(cluster, names[(seed + i) % len(names)],
+                     keys[(i * 3) % len(keys)], rng)
+        builder.ingest(inc, collect_all(
+            inc, default_collectors(cluster, cfg), parallel=False))
+    return cluster, builder
+
+
+def _tenant_verdicts(pack: MultiTenantScorer, tenant: str):
+    rows = pack.tenant_rows(pack.serve())[tenant]
+    order = np.argsort(np.asarray(rows["incident_ids"], object))
+    return tuple(np.asarray(rows[k])[order].tobytes()
+                 for k in _VERDICT_KEYS)
+
+
+def _stop_fleet(srv: SurgeServer):
+    for pack in list(srv._packs.values()):
+        pack.stop_warm(join=False)
+
+
+# -- the hysteresis gate ----------------------------------------------------
+
+def test_hysteresis_gate_dwell_and_flap_immunity():
+    """The StormMode pattern, direction-agnostic: pressure must be
+    SUSTAINED for dwell_s before the gate fires, and any calm sample
+    restarts the clock — a flapping signal can never fire it."""
+    t = [0.0]
+    gate = _HysteresisGate(dwell_s=10.0, clock=lambda: t[0])
+    assert not gate.update(True)          # entry starts the clock
+    t[0] = 9.9
+    assert not gate.update(True)          # not yet sustained
+    t[0] = 10.0
+    assert gate.update(True)              # dwell elapsed -> fires
+    gate.reset()                          # the act of scaling resets
+    t[0] = 15.0
+    assert not gate.update(True)          # fresh episode, fresh clock
+    t[0] = 24.0
+    assert not gate.update(False)         # calm wipes the episode
+    t[0] = 25.0
+    assert not gate.update(True)          # flap: clock restarted
+    t[0] = 34.9
+    assert not gate.update(True)
+    t[0] = 35.0
+    assert gate.update(True)
+
+
+# -- the divisor ladder -----------------------------------------------------
+
+def test_elastic_ladder_and_single_rung_steps():
+    """Viable shard counts are exactly the divisors of padded_nodes
+    that fit the non-excluded device count, and the controller steps
+    ONE rung at a time in either direction."""
+    scorer = SimpleNamespace(
+        snapshot=SimpleNamespace(padded_nodes=384),
+        _graph_size=lambda: 2)
+    shield = SimpleNamespace(scorer=scorer, _mesh_excluded=())
+    ec = ElasticController(shield, load_settings())
+    assert ec.ladder() == (1, 2, 3, 4, 6, 8)   # divisors of 384 <= 8
+    assert ec._step(+1) == 3
+    assert ec._step(-1) == 1
+    scorer._graph_size = lambda: 8
+    assert ec._step(+1) is None                # top of the ladder
+    shield._mesh_excluded = (6, 7)
+    assert ec.ladder() == (1, 2, 3, 4, 6)      # excluded devices shrink it
+
+
+def test_elastic_observe_scales_after_dwell_and_respects_cooldown():
+    """observe() holds until the up-gate sustains past dwell, then
+    executes prewarm -> scale_mesh exactly once, resets both gates, and
+    the cooldown blocks an immediate second event."""
+    t = [0.0]
+    calls = []
+    scorer = SimpleNamespace(
+        snapshot=SimpleNamespace(padded_nodes=384),
+        _graph_size=lambda: 2, pipeline_depth=2,
+        _inflight=(1, 2), stall_seconds=0.0,
+        _scope_entry="streaming.rules_tick", _scope_pack="0")
+    shield = SimpleNamespace(
+        scorer=scorer, _mesh_excluded=(),
+        scale_mesh=lambda d: (calls.append(("scale", d)) or
+                              {"from_shards": 2, "shards": d,
+                               "direction": "up", "heal_gen": 1}))
+    cfg = load_settings(elastic_enabled=True, elastic_dwell_s=5.0,
+                        elastic_cooldown_s=30.0)
+    ec = ElasticController(shield, cfg, clock=lambda: t[0])
+    ec.prewarm = lambda d, **kw: calls.append(("prewarm", d))
+    assert ec.observe()["action"] == "hold"     # occupancy 1.0 = hot...
+    t[0] = 4.9
+    assert ec.observe()["action"] == "hold"     # ...but not sustained
+    t[0] = 5.0
+    dec = ec.observe()                          # dwell elapsed
+    assert dec["action"] == "scale_up" and dec["plan"]["shards"] == 3
+    assert calls == [("prewarm", 3), ("scale", 3)]  # warm BEFORE scale
+    t[0] = 20.0
+    assert ec.observe()["action"] == "hold"     # cooldown holds it down
+    assert ec.scale_ups == 1 and ec.stats()["decisions"] == 4
+
+
+def test_elastic_disabled_never_scales():
+    scorer = SimpleNamespace(
+        snapshot=SimpleNamespace(padded_nodes=384),
+        _graph_size=lambda: 2, pipeline_depth=1, _inflight=(1,),
+        stall_seconds=0.0, _scope_entry="streaming.rules_tick",
+        _scope_pack="0")
+    shield = SimpleNamespace(scorer=scorer, _mesh_excluded=(),
+                             scale_mesh=lambda d: pytest.fail("scaled"))
+    t = [0.0]
+    ec = ElasticController(shield, load_settings(elastic_dwell_s=0.0),
+                           clock=lambda: t[0])
+    for _ in range(3):
+        t[0] += 10.0
+        assert ec.observe()["action"] == "hold"
+
+
+# -- live scale events through the heal seams -------------------------------
+
+@pytest.fixture(scope="module")
+def scale_baseline():
+    """Never-scaled D=4 serving over the scripted churn — the parity
+    reference every scale outcome is judged against."""
+    settings = _settings(serve_graph_shards=4)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings, directory=tempfile.mkdtemp(prefix="kaeg-swell-"))
+    shield.recover_or_snapshot()
+    stream = list(churn_events(
+        cluster, EVENTS, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    for s in range(0, len(stream), BATCH):
+        for ev in stream[s:s + BATCH]:
+            store_step(cluster, builder.store, ev)
+        shield.tick()
+    out = shield.rescore()
+    assert shield.heals == 0 and shield.scale_events == 0
+    return out, injected
+
+
+def _run_scaled_churn(scale_script, settings=None, events=EVENTS):
+    """Churn with mid-script scale events: ``scale_script`` maps batch
+    index -> target shard count (pre-warmed through warm_mesh before
+    each event — the ElasticController discipline)."""
+    settings = settings or _settings(serve_graph_shards=4)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings, directory=tempfile.mkdtemp(prefix="kaeg-swell-"))
+    shield.recover_or_snapshot()
+    stream = list(churn_events(
+        cluster, events, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    for bi, s in enumerate(range(0, len(stream), BATCH)):
+        for ev in stream[s:s + BATCH]:
+            store_step(cluster, builder.store, ev)
+        target = scale_script.get(bi)
+        if target is not None:
+            scorer.warm_mesh(survivor_mesh(target, ()),
+                             delta_sizes=(64,), row_sizes=(4, 16))
+            plan = shield.scale_mesh(target)
+            assert plan is not None and plan["shards"] == target
+        shield.tick()
+    out = shield.rescore()
+    return out, shield, injected
+
+
+def test_scale_roundtrip_bit_parity(scale_baseline):
+    """D=4 -> D'=3 -> D=4 under churn: rules verdicts BIT-identical to
+    never-scaled D=4 serving, both scale events WAL-journaled, the
+    shards gauge tracking the live count."""
+    base, injected_b = scale_baseline
+    out, shield, injected = _run_scaled_churn({1: 3, 4: 4})
+    assert shield.scale_events == 2
+    assert shield.scorer._graph_size() == 4
+    assert obs_metrics.MESH_SCALE_EVENTS.value(direction="up") >= 1
+    assert obs_metrics.MESH_SCALE_EVENTS.value(direction="down") >= 1
+    mine, ref = _verdicts(out, injected), _verdicts(base, injected_b)
+    assert mine.keys() == ref.keys()
+    for iid in ref:
+        assert mine[iid] == ref[iid], f"verdict diverged for {iid}"
+    # both scale events were WAL-journaled ahead of adoption; the forced
+    # post-scale snapshot may legally compact the records away once it
+    # carries their heal generation, so durable evidence is EITHER the
+    # live records OR a snapshot at (or past) the last scale's heal_gen
+    batches, _torn = shield.journal.read()
+    live = [b.meta["shards"] for b in batches
+            if b.kind == "mesh_heal" and b.meta.get("scale")]
+    snap = shield.journal.load_snapshot() or {}
+    assert live == [3, 4] or snap.get("heal_gen", -1) >= shield._heal_gen
+    assert shield._heal_gen >= 2
+
+
+def test_scale_event_survives_crash_through_the_journal(scale_baseline):
+    """One WAL winner: a scale event that reached the journal replays
+    to the SAME shard count after a crash (resident state corrupted
+    post-scale), verdicts bit-identical to the unscaled baseline."""
+    base, injected_b = scale_baseline
+    out, shield, injected = _run_scaled_churn({2: 3})
+    assert shield.scorer._graph_size() == 3
+    pre = _verdicts(out, injected)
+    FaultInjector._corrupt_resident(shield.scorer)
+    shield.recover()
+    assert shield.scorer._graph_size() == 3, \
+        "journal replay lost the scale event"
+    post = _verdicts(shield.rescore(), injected)
+    assert post == pre
+    ref = _verdicts(base, injected_b)
+    assert post == ref
+
+
+def test_scale_mesh_rejects_invalid_targets():
+    settings = _settings(serve_graph_shards=2)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings, directory=tempfile.mkdtemp(prefix="kaeg-swell-"))
+    shield.recover_or_snapshot()
+    try:
+        assert shield.scale_mesh(2) is None          # no-op at D
+        with pytest.raises(ValueError):
+            shield.scale_mesh(5)                     # 384 % 5 != 0
+        with pytest.raises(RuntimeError):
+            shield.scale_mesh(384)                   # > device count
+    finally:
+        scorer.stop_warm(join=False)
+
+
+def test_elastic_controller_scales_live_world_end_to_end():
+    """The controller against a REAL shielded world: sustained pressure
+    (forced hot signals) executes prewarm -> scale_mesh through the
+    actual seams, one rung up, verdicts bit-identical across the
+    event."""
+    settings = _settings(serve_graph_shards=2, elastic_enabled=True,
+                         elastic_dwell_s=0.0, elastic_cooldown_s=0.0)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings, directory=tempfile.mkdtemp(prefix="kaeg-swell-"))
+    shield.recover_or_snapshot()
+    try:
+        before = _verdicts(shield.rescore(), injected)
+        ec = ElasticController(shield, settings)
+        ec._hot = lambda sig: True
+        ec._cold = lambda sig: False
+        dec = ec.observe()
+        assert dec["action"] == "scale_up"
+        assert shield.scorer._graph_size() == 3
+        assert shield.scale_events == 1 and ec.scale_ups == 1
+        after = _verdicts(shield.rescore(), injected)
+        assert after == before
+    finally:
+        scorer.stop_warm(join=False)
+
+
+def test_gnn_scale_census_and_verdict_parity():
+    """The GNN tick scales too: after D=4 -> D'=3 the live tick's
+    collective census collapses to exactly (LAYERS+1)·D' ppermutes with
+    zero all-gathers/psums, and verdicts match a fresh D'=3 world (the
+    graft-fleet churn contract through the scale seam)."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.cost_model import (
+        cost_jaxpr)
+    from kubernetes_aiops_evidence_graph_tpu.analysis.registry import LAYERS
+    from kubernetes_aiops_evidence_graph_tpu.rca import gnn
+    from kubernetes_aiops_evidence_graph_tpu.rca.gnn_streaming import (
+        GnnStreamingScorer)
+    params = gnn.init_params(jax.random.PRNGKey(0))
+
+    def run(shards, scale_to=None):
+        settings = _settings(serve_graph_shards=shards)
+        cluster, builder, injected = _world(settings)
+        sc = GnnStreamingScorer(builder.store, settings, params=params,
+                                now_s=cluster.now.timestamp())
+        shield = ShieldedScorer(sc, settings,
+                                directory=tempfile.mkdtemp(
+                                    prefix="kaeg-swell-gnn-"))
+        shield.recover_or_snapshot()
+        stream = list(churn_events(
+            cluster, 60, seed=99,
+            incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+        for bi, s in enumerate(range(0, len(stream), BATCH)):
+            for ev in stream[s:s + BATCH]:
+                store_step(cluster, builder.store, ev)
+            if scale_to is not None and bi == 1:
+                shield.scale_mesh(scale_to)
+            shield.tick()
+        return shield.rescore(), shield, injected
+
+    base, _bs, binj = run(3)
+    out, shield, injected = run(4, scale_to=3)
+    s = shield.scorer
+    assert shield.scale_events == 1 and s._graph_size() == 3
+    pf, pb = _verdicts(out, injected), _verdicts(base, binj)
+    assert pf.keys() == pb.keys()
+    rows_f = {iid: r for r, iid in enumerate(out["incident_ids"])}
+    rows_b = {iid: r for r, iid in enumerate(base["incident_ids"])}
+    alias_f = {f"incident:{inc.id}": f"inj-{i}"
+               for i, inc in enumerate(injected)}
+    alias_b = {f"incident:{inc.id}": f"inj-{i}"
+               for i, inc in enumerate(binj)}
+    inv_f = {v: k for k, v in alias_f.items()}
+    inv_b = {v: k for k, v in alias_b.items()}
+    for key in pb:
+        rf = rows_f[inv_f.get(key, key)]
+        rb = rows_b[inv_b.get(key, key)]
+        np.testing.assert_allclose(
+            np.asarray(out["probs"])[rf], np.asarray(base["probs"])[rb],
+            rtol=2e-4, atol=1e-6, err_msg=f"probs diverged for {key}")
+        assert (out["top_rule_index"][rf] == base["top_rule_index"][rb])
+    # census at D': exactly (LAYERS+1)·3 ppermutes, nothing else
+    tick = s._sharded_tick_fn(64, 64)
+    g, pi = s._graph_size(), s.snapshot.padded_incidents
+    sds = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        (s._params, s._features_dev, s._kind_dev, s._nmask_dev,
+         s._esrc_dev, s._edst_dev, s._erel_dev, s._emask_dev))
+    ints = jax.ShapeDtypeStruct((g, 3 * 64 + 5 * 64 + 2 * pi), np.int32)
+    cost = cost_jaxpr("scaled.gnn_tick", jax.make_jaxpr(tick)(*sds, ints))
+    assert cost.collectives["ppermute"]["count"] == (LAYERS + 1) * 3
+    assert "all_gather" not in cost.collectives
+    assert "psum" not in cost.collectives
+
+
+# -- multi-pack fleets + live tenant migration ------------------------------
+
+def _fleet(max_packs=2, pack_tenants=2, tenants=3, journal_path=None,
+           seeds=(0, 1, 2)):
+    cfg = load_settings(**FLEET_CFG, swell_max_packs=max_packs,
+                        swell_pack_tenants=pack_tenants)
+    srv = SurgeServer(cfg, journal_path=journal_path)
+    stores = {}
+    for i in range(tenants):
+        _, builder = _tenant_world(seeds[i % len(seeds)] + 10 * i)
+        stores[f"t{i}"] = builder.store
+        srv.register(f"t{i}", builder.store)
+    return srv, stores
+
+
+def test_fleet_binpacks_tenants_across_packs():
+    """3 tenants at pack_tenants=2 land as {pack0: t0 t1, pack1: t2};
+    scorer(tenant) resolves the owning pack, per-pack telemetry carries
+    the pack label, and the fleet surface reports it all."""
+    srv, _stores = _fleet()
+    try:
+        p0, p2 = srv.scorer("t0"), srv.scorer("t2")
+        assert srv.scorer("t1") is p0 and p0 is not p2
+        assert srv.scorer() is p0                      # back-compat no-arg
+        assert p0._scope_pack == "0" and p2._scope_pack == "1"
+        assert p0.scope.pack == "0" and p2.scope.pack == "1"
+        fleet = srv.fleet()
+        assert fleet["packs"]["0"]["tenants"] == ["t0", "t1"]
+        assert fleet["packs"]["1"]["tenants"] == ["t2"]
+        assert fleet["placement"] == {"t0": 0, "t1": 0, "t2": 1}
+        assert obs_metrics.FLEET_PACKS.value() == 2.0
+        assert srv.fresh()
+    finally:
+        _stop_fleet(srv)
+
+
+def test_fleet_places_new_tenant_on_least_loaded_pack():
+    """Load-driven bin-packing: when every pack is at capacity the new
+    tenant lands on the least-loaded one (admitted-rows/s EWMA from the
+    store-journal cursors, injectable clock)."""
+    cfg = load_settings(**FLEET_CFG, swell_max_packs=2,
+                        swell_pack_tenants=1)
+    srv = SurgeServer(cfg)
+    cluster0, builder0 = _tenant_world(3)
+    _, builder1 = _tenant_world(14)
+    srv.register("t0", builder0.store)
+    srv.register("t1", builder1.store)
+    assert srv.fleet()["placement"] == {"t0": 0, "t1": 1}
+    srv.sample_loads(now_s=0.0)
+    # only t0's store admits rows between samples -> t0's EWMA > 0
+    rng = np.random.default_rng(7)
+    inc = inject(cluster0, sorted(SCENARIOS)[0],
+                 sorted(cluster0.deployments)[0], rng)
+    builder0.ingest(inc, collect_all(
+        inc, default_collectors(cluster0, cfg), parallel=False))
+    loads = srv.sample_loads(now_s=1.0)
+    assert loads["t0"] > 0.0 and loads.get("t1", 0.0) == 0.0
+    _, builder2 = _tenant_world(25)
+    srv.register("t2", builder2.store)   # both packs full -> least loaded
+    assert srv.fleet()["placement"]["t2"] == 1
+
+
+def test_tenant_migration_live_parity_and_exactly_once():
+    """migrate() moves a tenant between LIVE packs: fleet-WAL intent
+    before any mutate, incremental repack on the source, adopt on the
+    destination, verdicts bit-identical across the handoff, and the
+    tenant served by exactly one pack before and after."""
+    srv, _stores = _fleet()
+    try:
+        p0, p1 = srv.scorer("t0"), srv.scorer("t2")
+        before = _tenant_verdicts(p0, "t1")
+        gen0 = srv.generation
+        res = srv.migrate("t1", 1)
+        assert res["moved"] and srv.migrations == 1
+        assert srv.generation == gen0 + 1
+        assert srv.fleet()["placement"]["t1"] == 1
+        # exactly one owner: the source pack dropped the region, the
+        # destination serves it — same bits
+        assert "t1" not in p0.tenant_rows(p0.serve())
+        dst = srv.scorer("t1")
+        assert dst is p1
+        assert _tenant_verdicts(dst, "t1") == before
+        # journal-before-mutate: intent precedes commit in the WAL
+        kinds = [r["kind"] for r in srv._fleet_journal.replay()]
+        assert kinds == ["migrate_intent", "migrate_commit"]
+        # the other tenants never moved
+        assert _tenant_verdicts(p0, "t0") == _tenant_verdicts(
+            srv.scorer("t0"), "t0")
+        assert srv.migrate("t1", 1) == {
+            "tenant": "t1", "src": 1, "dst": 1, "moved": False}
+    finally:
+        _stop_fleet(srv)
+
+
+@pytest.mark.fault_injection
+@pytest.mark.parametrize("boundary", [0, 1, 2],
+                         ids=["journal-append", "source-repack",
+                              "destination-adopt"])
+def test_crash_mid_migration_recovers_to_exactly_one_owner(boundary):
+    """Crash at EACH handoff boundary (after the WAL intent append,
+    after the source repack, after the destination adopt): a fresh
+    SurgeServer over the same fleet WAL rolls the intent forward —
+    the tenant has exactly one owner, its verdicts are bit-identical,
+    and no tenant is lost or duplicated."""
+    path = os.path.join(tempfile.mkdtemp(prefix="kaeg-fleet-"),
+                        "fleet.jsonl")
+    srv, stores = _fleet(journal_path=path)
+    try:
+        srv.scorer("t0")
+        srv.scorer("t2")
+        before = _tenant_verdicts(srv.scorer("t1"), "t1")
+        srv.fault_injector = FaultInjector(
+            [Fault("migrate", at=boundary)])
+        with pytest.raises(InjectedFault):
+            srv.migrate("t1", 1)
+    finally:
+        _stop_fleet(srv)
+    # the process dies here; a new one recovers over the same WAL
+    srv2 = SurgeServer(load_settings(**FLEET_CFG, swell_max_packs=2,
+                                     swell_pack_tenants=2),
+                       journal_path=path)
+    try:
+        for t, store in stores.items():
+            srv2.register(t, store)
+        placement = srv2.fleet()["placement"]
+        # roll-forward: the intent moved ownership to the destination
+        assert placement["t1"] == 1
+        owners = [pid for pid, info in srv2.fleet()["packs"].items()
+                  if "t1" in info["tenants"]]
+        assert len(owners) == 1, f"t1 owned by {owners}"
+        assert sorted(placement) == ["t0", "t1", "t2"]
+        assert _tenant_verdicts(srv2.scorer("t1"), "t1") == before
+        # the destination pack serves it; the source pack does not
+        src_pack = srv2.scorer("t0")
+        assert "t1" not in src_pack.tenant_rows(src_pack.serve())
+    finally:
+        _stop_fleet(srv2)
+
+
+def test_fleet_api_renders_two_migrations_in_order():
+    """GET /api/v1/fleet: placement, loads, and the history ring with
+    two migrations rendered in order."""
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.api import (
+        make_server)
+    srv, _stores = _fleet()
+    http = make_server(SimpleNamespace(surge=srv), "127.0.0.1", 0)
+    port = http.server_address[1]
+    thread = threading.Thread(target=http.serve_forever, daemon=True)
+    thread.start()
+    try:
+        srv.scorer("t0")
+        srv.scorer("t2")
+        srv.sample_loads(now_s=0.0)
+        srv.migrate("t1", 1)
+        srv.migrate("t1", 0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/fleet", timeout=30) as r:
+            fleet = json.loads(r.read())
+        assert fleet["enabled"] is True
+        assert fleet["migrations"] == 2
+        moves = [h for h in fleet["history"] if h["event"] == "migrate"]
+        assert [(m["tenant"], m["src"], m["dst"]) for m in moves] == [
+            ("t1", 0, 1), ("t1", 1, 0)]
+        assert fleet["placement"]["t1"] == 0
+        assert set(fleet["loads"]) <= {"t0", "t1", "t2"}
+        # scale decisions ride the same ring
+        srv.note_scale(0, {"action": "scale_up", "plan": {"shards": 2}})
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/fleet", timeout=30) as r:
+            fleet = json.loads(r.read())
+        assert fleet["history"][-1]["event"] == "scale_up"
+    finally:
+        http.shutdown()
+        _stop_fleet(srv)
+
+
+def test_fleet_api_without_surge_reports_disabled():
+    from kubernetes_aiops_evidence_graph_tpu.ingestion.api import (
+        make_server)
+    http = make_server(SimpleNamespace(), "127.0.0.1", 0)
+    port = http.server_address[1]
+    thread = threading.Thread(target=http.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/fleet", timeout=30) as r:
+            fleet = json.loads(r.read())
+        assert fleet == {"enabled": False, "packs": {}, "placement": {},
+                         "loads": {}, "history": [], "generation": 0,
+                         "migrations": 0}
+    finally:
+        http.shutdown()
+
+
+# -- chaos: interleaved scale + shard_loss ----------------------------------
+
+@pytest.mark.fault_injection
+def test_randomized_interleaved_scale_and_shard_loss_chaos(scale_baseline):
+    """Chaos: a seeded schedule interleaves elastic scale events with
+    shard_loss faults (raising and silent) — wherever they land, the
+    WAL serializes one winner per boundary and final verdicts stay
+    bit-identical to never-faulted D=4 serving. Seed echoed; replay
+    with KAEG_CHAOS_SEED=<seed>."""
+    seed = int(os.environ.get("KAEG_CHAOS_SEED", "20260806"))
+    print(f"\nswell chaos seed={seed}")
+    rng = np.random.default_rng(seed)
+    n_batches = EVENTS // BATCH
+    down_at = int(rng.integers(1, n_batches - 2))
+    up_at = int(rng.integers(down_at + 1, n_batches))
+    injector = FaultInjector.seeded(
+        seed, ticks=n_batches + 2, rate=0.2,
+        stages=("staging", "dispatch", "shard_loss"), shards=3)
+    settings = _settings(serve_graph_shards=4)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings,
+        directory=tempfile.mkdtemp(prefix="kaeg-swell-chaos-"),
+        injector=injector)
+    shield.recover_or_snapshot()
+    stream = list(churn_events(
+        cluster, EVENTS, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    scale_script = {down_at: 3, up_at: 4}
+    for bi, s in enumerate(range(0, len(stream), BATCH)):
+        for ev in stream[s:s + BATCH]:
+            store_step(cluster, builder.store, ev)
+        target = scale_script.get(bi)
+        if target is not None:
+            scorer.warm_mesh(
+                survivor_mesh(target, shield._mesh_excluded),
+                delta_sizes=(64,), row_sizes=(4, 16))
+            try:
+                shield.scale_mesh(target)
+            except (ValueError, RuntimeError):
+                pass   # a concurrent heal may have excluded devices
+        shield.tick()
+    # close the run at an attestation boundary: silent shard corruption
+    # is only detectable at snapshot capture (attest-then-persist), and
+    # the forced post-scale snapshots shift the cadence so the last tick
+    # need not land on one — exactly how a live deploy quiesces before
+    # reading final verdicts
+    shield.snapshot_now()
+    out = shield.rescore()
+    base, injected_b = scale_baseline
+    mine, ref = _verdicts(out, injected), _verdicts(base, injected_b)
+    assert mine.keys() == ref.keys()
+    for iid in ref:
+        assert mine[iid] == ref[iid], f"verdict diverged for {iid}"
+    for k in ("scores", "top_score"):
+        assert np.isfinite(np.asarray(out[k])).all()
+    # one WAL winner: replay lands on the journal's final shard count
+    final_d = shield.scorer._graph_size()
+    FaultInjector._corrupt_resident(shield.scorer)
+    shield.injector = None     # recovery itself runs unfaulted
+    shield.recover()
+    assert shield.scorer._graph_size() == final_d
+    assert _verdicts(shield.rescore(), injected) == mine
+
+
+# -- the CompileFence leg ---------------------------------------------------
+
+@pytest.mark.perf_contract
+def test_zero_compiles_inside_armed_scale_window():
+    """The warm contract, observed: with the scale targets pre-compiled
+    (warm_mesh at D' and D — the controller's prewarm discipline plus
+    one throwaway round-trip for the fetch paths), a D=4 -> 3 -> 4
+    scale round-trip under churn dispatches ZERO fresh XLA compiles
+    inside the armed fence window."""
+    from kubernetes_aiops_evidence_graph_tpu.analysis.runtime_guards import (
+        CompileFence)
+    settings = _settings(serve_graph_shards=4,
+                         shield_snapshot_every_ticks=10**9,
+                         mesh_attest=False)
+    cluster, builder, injected = _world(settings)
+    scorer = StreamingScorer(builder.store, settings,
+                             now_s=cluster.now.timestamp())
+    shield = ShieldedScorer(
+        scorer, settings, directory=tempfile.mkdtemp(prefix="kaeg-swell-"))
+    shield.recover_or_snapshot()
+    stream = list(churn_events(
+        cluster, EVENTS, seed=99,
+        incident_ids=tuple(f"incident:{i.id}" for i in injected)))
+    fence = CompileFence().install()
+    try:
+        # cold phase: declared warm paths + a throwaway round-trip so
+        # both layouts' tick AND fetch executables exist
+        scorer.warm(delta_sizes=(64,), row_sizes=(4, 16))
+        scorer.warm_mesh(survivor_mesh(3, ()), delta_sizes=(64,),
+                         row_sizes=(4, 16))
+        scorer.warm_mesh(survivor_mesh(4, ()), delta_sizes=(64,),
+                         row_sizes=(4, 16))
+        for ev in stream[:BATCH]:
+            store_step(cluster, builder.store, ev)
+        shield.tick()
+        shield.rescore()
+        shield.scale_mesh(3)
+        shield.tick()
+        shield.rescore()
+        shield.scale_mesh(4)
+        shield.tick()
+        shield.rescore()
+        # armed window: the live scale round-trip must be compile-free
+        fence.arm()
+        try:
+            with fence.region("swell:scale"):
+                for bi, s in enumerate(
+                        range(BATCH, len(stream), BATCH)):
+                    for ev in stream[s:s + BATCH]:
+                        store_step(cluster, builder.store, ev)
+                    if bi == 1:
+                        shield.scale_mesh(3)
+                    elif bi == 3:
+                        shield.scale_mesh(4)
+                    shield.tick()
+                out = shield.rescore()
+        finally:
+            fence.disarm()
+        fence.assert_clean()
+    finally:
+        fence.uninstall()
+        scorer.stop_warm(join=False)
+    assert out["incident_ids"], "premise: nothing served"
+    assert shield.scale_events >= 4
